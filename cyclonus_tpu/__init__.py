@@ -1,0 +1,21 @@
+"""cyclonus_tpu: a TPU-native Kubernetes NetworkPolicy engine, prober, and
+conformance-test generator.
+
+A ground-up rebuild of the capabilities of cyclonus (reference: Go implementation)
+with the simulated connectivity engine expressed as JAX kernels over dense tensor
+encodings of pods and policies, sharded over TPU meshes.  The scalar Python
+"oracle" reproduces the reference decision procedure exactly and serves as the
+parity check for the TPU engine.
+
+Layers (bottom-up), mirroring the reference architecture (see SURVEY.md):
+  kube         - k8s object model, label selector + CIDR matching, fake cluster
+  matcher      - policy compilation to matcher IR + scalar evaluation (oracle)
+  engine       - tensor compiler + TPU verdict kernels (the new hot path)
+  probe        - cluster model, probe job fan-out, truth tables
+  generator    - conformance test-case DSL and the 8 case families
+  connectivity - test interpreter, comparison tables, reporting
+  linter       - static + resolved policy checks
+  cli          - analyze / generate / probe commands
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""Small utilities (reference: pkg/utils)."""
+
+from .text import json_string, yaml_string
+from .table import render_table
+
+__all__ = ["json_string", "yaml_string", "render_table"]

@@ -1,0 +1,23 @@
+"""String helpers (reference: pkg/utils/utils.go:18-30)."""
+
+from __future__ import annotations
+
+import json
+
+import yaml
+
+
+def json_string(obj) -> str:
+    return json.dumps(obj, indent=2, default=_default)
+
+
+def yaml_string(obj) -> str:
+    return yaml.safe_dump(obj, sort_keys=False)
+
+
+def _default(obj):
+    if hasattr(obj, "to_dict"):
+        return obj.to_dict()
+    if hasattr(obj, "__dict__"):
+        return obj.__dict__
+    return str(obj)

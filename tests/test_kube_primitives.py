@@ -1,0 +1,151 @@
+"""L1 tests: label-selector and CIDR matching semantics.
+
+Golden cases ported from the reference's kube/ipaddress_tests.go and
+kube/labelselector_tests.go, plus extra coverage for the operator traps."""
+
+import pytest
+
+from cyclonus_tpu.kube import (
+    IPBlock,
+    LabelSelector,
+    LabelSelectorRequirement,
+    is_ip_address_match_for_ip_block,
+    is_ip_in_cidr,
+    is_labels_match_label_selector,
+    is_match_expression_match,
+    make_ipv4_cidr,
+)
+from cyclonus_tpu.kube.netpol import OP_DOES_NOT_EXIST, OP_EXISTS, OP_IN, OP_NOT_IN
+
+
+class TestIPInCIDR:
+    # ipaddress_tests.go:14-47
+    @pytest.mark.parametrize(
+        "ip,cidr,member",
+        [
+            ("1.2.3.3", "1.2.3.0/24", True),
+            ("1.2.3.3", "1.2.3.0/28", True),
+            ("1.2.3.3", "1.2.3.0/30", True),
+            ("1.2.3.3", "1.2.3.0/31", False),
+        ],
+    )
+    def test_membership(self, ip, cidr, member):
+        assert is_ip_in_cidr(ip, cidr) == member
+
+    def test_ipv6(self):
+        # The reference's IPv6 spec is an empty stub (ipaddress_tests.go:49-53);
+        # we actually cover it.
+        assert is_ip_in_cidr("2001:db8::68", "2001:db8::/32")
+        assert not is_ip_in_cidr("2001:db9::68", "2001:db8::/32")
+        # cross-family: no match
+        assert not is_ip_in_cidr("1.2.3.4", "2001:db8::/32")
+
+    def test_malformed(self):
+        with pytest.raises(ValueError):
+            is_ip_address_match_for_ip_block(
+                "abc", IPBlock.make(cidr="1.2.3.4")
+            )
+
+    def test_host_bits_masked(self):
+        # Go's ParseCIDR masks host bits: 1.2.3.4 parses as net 1.2.3.4/32?? no:
+        # "10.0.0.1/24" is the 10.0.0.0/24 network.
+        assert is_ip_in_cidr("10.0.0.99", "10.0.0.1/24")
+
+
+class TestIPBlock:
+    # ipaddress_tests.go:63-108
+    @pytest.mark.parametrize(
+        "ip,cidr,match",
+        [
+            ("1.2.3.3", "1.2.3.0/24", True),
+            ("1.2.3.3", "1.2.3.0/28", True),
+            ("1.2.3.3", "1.2.3.0/30", True),
+            ("1.2.3.3", "1.2.3.0/31", False),
+        ],
+    )
+    def test_no_except(self, ip, cidr, match):
+        assert is_ip_address_match_for_ip_block(ip, IPBlock.make(cidr=cidr)) == match
+
+    # ipaddress_tests.go:110-155
+    @pytest.mark.parametrize(
+        "ip,cidr,excepts,match",
+        [
+            ("1.2.3.3", "1.2.3.0/28", ["1.2.3.0/30"], False),
+            ("1.2.3.4", "1.2.3.0/28", ["1.2.3.4/30"], False),
+            ("1.2.3.3", "1.2.3.0/28", ["1.2.3.4/30"], True),
+        ],
+    )
+    def test_with_except(self, ip, cidr, excepts, match):
+        assert is_ip_address_match_for_ip_block(
+            ip, IPBlock.make(cidr=cidr)
+        ), "sanity: should match without except"
+        assert (
+            is_ip_address_match_for_ip_block(
+                ip, IPBlock.make(cidr=cidr, except_=excepts)
+            )
+            == match
+        )
+
+
+class TestMakeCIDR:
+    # ipaddress_tests.go:158-202
+    @pytest.mark.parametrize(
+        "ip,bits,expected",
+        [
+            ("255.255.255.255", 32, "255.255.255.255/32"),
+            ("255.255.255.255", 31, "255.255.255.254/31"),
+            ("255.255.255.255", 30, "255.255.255.252/30"),
+            ("255.255.255.255", 28, "255.255.255.240/28"),
+            ("255.255.255.255", 24, "255.255.255.0/24"),
+            ("255.255.255.255", 16, "255.255.0.0/16"),
+        ],
+    )
+    def test_normalized(self, ip, bits, expected):
+        assert make_ipv4_cidr(ip, bits) == expected
+
+
+class TestLabelSelector:
+    def test_empty_selector_matches_all(self):
+        # labelselector.go:84-85
+        assert is_labels_match_label_selector({}, LabelSelector.make())
+        assert is_labels_match_label_selector({"a": "b"}, LabelSelector.make())
+
+    def test_match_labels_anded(self):
+        sel = LabelSelector.make(match_labels={"a": "b", "c": "d"})
+        assert is_labels_match_label_selector({"a": "b", "c": "d", "e": "f"}, sel)
+        assert not is_labels_match_label_selector({"a": "b"}, sel)
+        assert not is_labels_match_label_selector({"a": "x", "c": "d"}, sel)
+
+    def test_in_operator(self):
+        exp = LabelSelectorRequirement("k", OP_IN, ("v1", "v2"))
+        assert is_match_expression_match({"k": "v1"}, exp)
+        assert is_match_expression_match({"k": "v2"}, exp)
+        assert not is_match_expression_match({"k": "v3"}, exp)
+        assert not is_match_expression_match({}, exp)
+
+    def test_not_in_operator_absent_key_is_no_match(self):
+        # The trap: NotIn with absent key => NOT a match
+        # (labelselector.go:37-49).
+        exp = LabelSelectorRequirement("k", OP_NOT_IN, ("v1",))
+        assert not is_match_expression_match({}, exp)
+        assert not is_match_expression_match({"k": "v1"}, exp)
+        assert is_match_expression_match({"k": "v2"}, exp)
+
+    def test_exists(self):
+        exp = LabelSelectorRequirement("k", OP_EXISTS)
+        assert is_match_expression_match({"k": "anything"}, exp)
+        assert not is_match_expression_match({"j": "x"}, exp)
+
+    def test_does_not_exist(self):
+        exp = LabelSelectorRequirement("k", OP_DOES_NOT_EXIST)
+        assert not is_match_expression_match({"k": "anything"}, exp)
+        assert is_match_expression_match({"j": "x"}, exp)
+
+    def test_combined_labels_and_expressions(self):
+        sel = LabelSelector.make(
+            match_labels={"a": "b"},
+            match_expressions=[LabelSelectorRequirement("k", OP_EXISTS)],
+        )
+        assert is_labels_match_label_selector({"a": "b", "k": "z"}, sel)
+        assert not is_labels_match_label_selector({"a": "b"}, sel)
+        assert not is_labels_match_label_selector({"k": "z"}, sel)

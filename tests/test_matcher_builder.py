@@ -1,0 +1,350 @@
+"""L2 tests: NetworkPolicy -> matcher construction
+(golden cases ported from the reference's matcher/builder_tests.go)."""
+
+import pytest
+
+from cyclonus_tpu.kube.netpol import (
+    IntOrString,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyEgressRule,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+    IPBlock,
+)
+from cyclonus_tpu.matcher import (
+    ALL_PEERS_PORTS,
+    AllNamespaceMatcher,
+    AllPodMatcher,
+    AllPortMatcher,
+    ExactNamespaceMatcher,
+    IPPeerMatcher,
+    LabelSelectorNamespaceMatcher,
+    LabelSelectorPodMatcher,
+    PodPeerMatcher,
+    PortsForAllPeersMatcher,
+    SpecificPortMatcher,
+    TrafficPeer,
+    build_ip_block_namespace_pod_matcher,
+    build_peer_matchers,
+    build_port_matcher,
+    build_target,
+)
+
+SELECTOR_EMPTY = LabelSelector.make()
+SELECTOR_AB = LabelSelector.make(match_labels={"a": "b"})
+SELECTOR_CD = LabelSelector.make(match_labels={"c": "d"})
+IPBLOCK_10_0_0_1_24 = IPBlock.make(cidr="10.0.0.1/24")
+IPBLOCK_192_168_242_213_24 = IPBlock.make(cidr="192.168.242.213/24")
+NS = "default"
+
+
+def mkpolicy(
+    policy_types,
+    ingress=None,
+    egress=None,
+    namespace="default",
+    name="abc",
+) -> NetworkPolicy:
+    return NetworkPolicy(
+        name=name,
+        namespace=namespace,
+        spec=NetworkPolicySpec(
+            pod_selector=SELECTOR_EMPTY,
+            policy_types=policy_types,
+            ingress=ingress or [],
+            egress=egress or [],
+        ),
+    )
+
+
+class TestBuildTarget:
+    def test_allow_no_ingress(self):
+        # builder_tests.go:24-32: nil ingress => target exists, no peers
+        ingress, egress = build_target(mkpolicy(["Ingress"]))
+        assert ingress is not None
+        assert ingress.peers == []
+        assert egress is None
+
+    def test_allow_no_egress(self):
+        ingress, egress = build_target(mkpolicy(["Egress"]))
+        assert egress is not None
+        assert egress.peers == []
+        assert ingress is None
+
+    def test_allow_neither(self):
+        ingress, egress = build_target(mkpolicy(["Ingress", "Egress"]))
+        assert ingress is not None and ingress.peers == []
+        assert egress is not None and egress.peers == []
+
+    def test_missing_namespace_defaults(self):
+        # builder_tests.go:54-69
+        pol = mkpolicy(["Ingress", "Egress"], namespace="")
+        ingress, egress = build_target(pol)
+        assert ingress.namespace == "default"
+        assert egress.namespace == "default"
+
+    def test_no_policy_types_raises(self):
+        with pytest.raises(ValueError):
+            build_target(mkpolicy([]))
+
+    def test_allow_all_ingress(self):
+        # builder_tests.go:101-122: single empty rule => AllPeersPorts
+        pol = mkpolicy(["Ingress"], ingress=[NetworkPolicyIngressRule()])
+        ingress, egress = build_target(pol)
+        assert egress is None
+        assert ingress.peers == [ALL_PEERS_PORTS]
+
+    def test_allow_all_egress(self):
+        pol = mkpolicy(["Egress"], egress=[NetworkPolicyEgressRule()])
+        ingress, egress = build_target(pol)
+        assert ingress is None
+        assert egress.peers == [ALL_PEERS_PORTS]
+
+
+class TestBuildPeerMatchers:
+    def test_empty_ports_and_peers(self):
+        # builder_tests.go:186-189
+        assert build_peer_matchers("abc", [], []) == [ALL_PEERS_PORTS]
+
+    def test_specific_port_empty_peers(self):
+        # builder_tests.go:191-201
+        matchers = build_peer_matchers(
+            "abc",
+            [NetworkPolicyPort(protocol="SCTP", port=IntOrString(103))],
+            [],
+        )
+        assert len(matchers) == 1
+        m = matchers[0]
+        assert isinstance(m, PortsForAllPeersMatcher)
+        assert isinstance(m.port, SpecificPortMatcher)
+        assert m.port.ports[0].protocol == "SCTP"
+        assert m.port.ports[0].port == IntOrString(103)
+
+    def test_single_ipblock(self):
+        # builder_tests.go:203-212
+        matchers = build_peer_matchers(
+            "abc", [], [NetworkPolicyPeer(ip_block=IPBLOCK_10_0_0_1_24)]
+        )
+        assert len(matchers) == 1
+        m = matchers[0]
+        assert isinstance(m, IPPeerMatcher)
+        assert m.ip_block == IPBLOCK_10_0_0_1_24
+        assert isinstance(m.port, AllPortMatcher)
+
+    def test_empty_pod_and_ns_selectors(self):
+        # builder_tests.go:214-223
+        matchers = build_peer_matchers(
+            "abc",
+            [],
+            [
+                NetworkPolicyPeer(
+                    pod_selector=SELECTOR_EMPTY, namespace_selector=SELECTOR_EMPTY
+                )
+            ],
+        )
+        assert len(matchers) == 1
+        m = matchers[0]
+        assert isinstance(m, PodPeerMatcher)
+        assert isinstance(m.namespace, AllNamespaceMatcher)
+        assert isinstance(m.pod, AllPodMatcher)
+        assert isinstance(m.port, AllPortMatcher)
+
+    def test_empty_pod_selector_only(self):
+        # builder_tests.go:225-235
+        matchers = build_peer_matchers(
+            "abc", [], [NetworkPolicyPeer(pod_selector=SELECTOR_EMPTY)]
+        )
+        m = matchers[0]
+        assert isinstance(m, PodPeerMatcher)
+        assert m.namespace == ExactNamespaceMatcher(namespace="abc")
+        assert isinstance(m.pod, AllPodMatcher)
+
+    def test_dns_style_multi_rule(self):
+        # builder_tests.go:151-182: pod peer + ipblock on TCP:80 plus
+        # all-peers on UDP:53
+        p80 = NetworkPolicyPort(protocol="TCP", port=IntOrString(80))
+        p53 = NetworkPolicyPort(protocol="UDP", port=IntOrString(53))
+        pol = mkpolicy(
+            ["Egress"],
+            egress=[
+                NetworkPolicyEgressRule(
+                    ports=[p80],
+                    to=[
+                        NetworkPolicyPeer(pod_selector=SELECTOR_EMPTY),
+                        NetworkPolicyPeer(ip_block=IPBLOCK_192_168_242_213_24),
+                    ],
+                ),
+                NetworkPolicyEgressRule(ports=[p53]),
+            ],
+            namespace="abc",
+        )
+        _, egress = build_target(pol)
+        peers = egress.peers
+        assert len(peers) == 3
+        pod_peer, ip_peer, all_peer = peers
+        assert isinstance(pod_peer, PodPeerMatcher)
+        assert pod_peer.namespace == ExactNamespaceMatcher(namespace="abc")
+        assert isinstance(ip_peer, IPPeerMatcher)
+        assert isinstance(all_peer, PortsForAllPeersMatcher)
+        # the ip matcher allows a matching ip on TCP 80
+        assert ip_peer.allows(TrafficPeer(ip="192.168.242.249"), 80, "", "TCP")
+        assert not ip_peer.allows(TrafficPeer(ip="192.168.242.249"), 81, "", "TCP")
+        assert not ip_peer.allows(TrafficPeer(ip="192.168.243.249"), 80, "", "TCP")
+
+
+class TestBuildIPBlockNamespacePodMatcher:
+    # builder_tests.go:238-311: all 6 ns/pod selector combos + ipblock
+    def test_nil_selectors(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(NS, NetworkPolicyPeer(
+            pod_selector=SELECTOR_EMPTY))
+        assert ip is None
+        assert ns == ExactNamespaceMatcher(namespace=NS)
+        assert isinstance(pod, AllPodMatcher)
+
+    def test_all_pods_all_namespaces(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(
+            NS,
+            NetworkPolicyPeer(
+                pod_selector=SELECTOR_EMPTY, namespace_selector=SELECTOR_EMPTY
+            ),
+        )
+        assert ip is None
+        assert isinstance(ns, AllNamespaceMatcher)
+        assert isinstance(pod, AllPodMatcher)
+
+    def test_all_pods_matching_namespaces(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(
+            NS,
+            NetworkPolicyPeer(
+                pod_selector=SELECTOR_EMPTY, namespace_selector=SELECTOR_AB
+            ),
+        )
+        assert ip is None
+        assert ns == LabelSelectorNamespaceMatcher(selector=SELECTOR_AB)
+        assert isinstance(pod, AllPodMatcher)
+
+    def test_matching_pods_policy_namespace(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(
+            NS, NetworkPolicyPeer(pod_selector=SELECTOR_CD)
+        )
+        assert ip is None
+        assert ns == ExactNamespaceMatcher(namespace=NS)
+        assert pod == LabelSelectorPodMatcher(selector=SELECTOR_CD)
+
+    def test_matching_pods_all_namespaces(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(
+            NS,
+            NetworkPolicyPeer(
+                pod_selector=SELECTOR_CD, namespace_selector=SELECTOR_EMPTY
+            ),
+        )
+        assert ip is None
+        assert isinstance(ns, AllNamespaceMatcher)
+        assert pod == LabelSelectorPodMatcher(selector=SELECTOR_CD)
+
+    def test_matching_pods_matching_namespaces(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(
+            NS,
+            NetworkPolicyPeer(
+                pod_selector=SELECTOR_CD, namespace_selector=SELECTOR_AB
+            ),
+        )
+        assert ip is None
+        assert ns == LabelSelectorNamespaceMatcher(selector=SELECTOR_AB)
+        assert pod == LabelSelectorPodMatcher(selector=SELECTOR_CD)
+
+    def test_ipblock(self):
+        ip, ns, pod = build_ip_block_namespace_pod_matcher(
+            NS, NetworkPolicyPeer(ip_block=IPBLOCK_10_0_0_1_24)
+        )
+        assert ip is not None
+        assert ip.ip_block == IPBLOCK_10_0_0_1_24
+        assert ns is None
+        assert pod is None
+
+    def test_all_nil_peer_is_policy_namespace_all_pods(self):
+        # A peer with every field nil maps to ExactNamespace(policy ns) +
+        # AllPod (builder.go:115-142; the all-nil guard at builder.go:94 is
+        # unreachable from that mapping).
+        matchers = build_peer_matchers(NS, [], [NetworkPolicyPeer()])
+        m = matchers[0]
+        assert isinstance(m, PodPeerMatcher)
+        assert m.namespace == ExactNamespaceMatcher(namespace=NS)
+        assert isinstance(m.pod, AllPodMatcher)
+
+    def test_ipblock_wins_over_selectors(self):
+        # builder.go:116-121: a non-nil IPBlock short-circuits; selectors on
+        # the same peer are ignored (the invalid-peer guard at builder.go:97
+        # is unreachable).
+        matchers = build_peer_matchers(
+            NS,
+            [],
+            [
+                NetworkPolicyPeer(
+                    ip_block=IPBLOCK_10_0_0_1_24, pod_selector=SELECTOR_AB
+                )
+            ],
+        )
+        assert len(matchers) == 1
+        assert isinstance(matchers[0], IPPeerMatcher)
+
+
+class TestBuildPortMatcher:
+    def test_empty_is_all(self):
+        # builder_tests.go:313-317
+        assert isinstance(build_port_matcher([]), AllPortMatcher)
+
+    def test_all_ports_on_protocol(self):
+        pm = build_port_matcher([NetworkPolicyPort(protocol="SCTP")])
+        assert isinstance(pm, SpecificPortMatcher)
+        assert pm.ports[0].port is None
+        assert pm.ports[0].protocol == "SCTP"
+
+    def test_numbered_port(self):
+        pm = build_port_matcher(
+            [NetworkPolicyPort(protocol="TCP", port=IntOrString(9001))]
+        )
+        assert pm.ports[0].port == IntOrString(9001)
+        assert pm.ports[0].protocol == "TCP"
+
+    def test_named_port(self):
+        pm = build_port_matcher(
+            [NetworkPolicyPort(protocol="UDP", port=IntOrString("hello"))]
+        )
+        assert pm.ports[0].port == IntOrString("hello")
+        assert pm.ports[0].protocol == "UDP"
+
+    def test_default_protocol_tcp(self):
+        pm = build_port_matcher([NetworkPolicyPort(port=IntOrString(80))])
+        assert pm.ports[0].protocol == "TCP"
+
+    def test_port_range(self):
+        pm = build_port_matcher(
+            [
+                NetworkPolicyPort(
+                    protocol="TCP", port=IntOrString(80), end_port=90
+                )
+            ]
+        )
+        assert len(pm.port_ranges) == 1
+        r = pm.port_ranges[0]
+        assert (r.from_port, r.to_port, r.protocol) == (80, 90, "TCP")
+        assert r.allows_port_protocol(85, "TCP")
+        assert not r.allows_port_protocol(91, "TCP")
+        assert not r.allows_port_protocol(85, "UDP")
+
+    def test_invalid_ranges_raise(self):
+        # builder.go:161-187 panics
+        with pytest.raises(ValueError):
+            build_port_matcher([NetworkPolicyPort(end_port=90)])
+        with pytest.raises(ValueError):
+            build_port_matcher(
+                [NetworkPolicyPort(port=IntOrString("x"), end_port=90)]
+            )
+        with pytest.raises(ValueError):
+            build_port_matcher(
+                [NetworkPolicyPort(port=IntOrString(100), end_port=90)]
+            )

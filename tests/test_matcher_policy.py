@@ -1,0 +1,260 @@
+"""L2 tests: end-to-end IsTrafficAllowed semantics from YAML policies
+(golden cases ported from the reference's matcher/policy_tests.go)."""
+
+from cyclonus_tpu.kube.yaml_io import load_policies_from_yaml
+from cyclonus_tpu.matcher import (
+    InternalPeer,
+    Traffic,
+    TrafficPeer,
+    build_network_policies,
+)
+
+ALLOW_ALL_ON_SCTP = """
+apiVersion: networking.k8s.io/v1
+kind: NetworkPolicy
+metadata:
+  name: policy-207
+  namespace: x
+spec:
+  ingress:
+  - ports:
+    - protocol: SCTP
+  podSelector: {}
+  policyTypes:
+  - Ingress
+"""
+
+
+def internal(ns, pod_labels=None, ns_labels=None, ip="1.2.3.4"):
+    return TrafficPeer(
+        internal=InternalPeer(
+            pod_labels=pod_labels or {},
+            namespace_labels=ns_labels or {},
+            namespace=ns,
+        ),
+        ip=ip,
+    )
+
+
+class TestProtocolIsolation:
+    # policy_tests.go:31-125
+    def setup_method(self):
+        self.policy = build_network_policies(
+            True, load_policies_from_yaml(ALLOW_ALL_ON_SCTP)
+        )
+
+    def test_tcp_denied_from_pod(self):
+        t = Traffic(
+            source=internal("y"),
+            destination=internal("x", ip="1.2.3.5"),
+            resolved_port=103,
+            protocol="TCP",
+        )
+        assert not self.policy.is_traffic_allowed(t).is_allowed
+
+    def test_sctp_allowed_from_pod(self):
+        t = Traffic(
+            source=internal("y"),
+            destination=internal("x", ip="1.2.3.5"),
+            resolved_port=103,
+            protocol="SCTP",
+        )
+        assert self.policy.is_traffic_allowed(t).is_allowed
+
+    def test_tcp_denied_from_external_ip(self):
+        t = Traffic(
+            source=TrafficPeer(ip="1.2.3.4"),
+            destination=internal("x", ip="1.2.3.5"),
+            resolved_port=103,
+            protocol="TCP",
+        )
+        assert not self.policy.is_traffic_allowed(t).is_allowed
+
+    def test_sctp_allowed_from_external_ip(self):
+        t = Traffic(
+            source=TrafficPeer(ip="1.2.3.4"),
+            destination=internal("x", ip="1.2.3.5"),
+            resolved_port=103,
+            protocol="SCTP",
+        )
+        assert self.policy.is_traffic_allowed(t).is_allowed
+
+
+EGRESS_TO_IPS = """
+apiVersion: networking.k8s.io/v1
+kind: NetworkPolicy
+metadata:
+  name: vary-egress-37-0-0-0-19
+  namespace: x
+spec:
+  egress:
+  - ports:
+    - port: 80
+      protocol: TCP
+    to:
+    - podSelector: {}
+    - ipBlock:
+        cidr: 192.168.242.213/24
+  - ports:
+    - port: 53
+      protocol: UDP
+  podSelector:
+    matchLabels:
+      pod: a
+  policyTypes:
+  - Egress
+"""
+
+
+class TestEgressToIPs:
+    # policy_tests.go:127-180
+    def test_allows_ip_in_cidr(self):
+        policy = build_network_policies(
+            True, load_policies_from_yaml(EGRESS_TO_IPS)
+        )
+        t = Traffic(
+            source=internal("x", {"pod": "a"}, {"ns": "x"}, ip="1.2.3.4"),
+            destination=internal("y", {"pod": "b"}, {"ns": "y"}, ip="192.168.242.249"),
+            resolved_port=80,
+            protocol="TCP",
+        )
+        assert policy.is_traffic_allowed(t).is_allowed
+
+    def test_blocks_ip_outside_cidr_and_pods_outside_ns(self):
+        policy = build_network_policies(
+            True, load_policies_from_yaml(EGRESS_TO_IPS)
+        )
+        t = Traffic(
+            source=internal("x", {"pod": "a"}, {"ns": "x"}, ip="1.2.3.4"),
+            destination=internal("y", {"pod": "b"}, {"ns": "y"}, ip="10.1.2.3"),
+            resolved_port=80,
+            protocol="TCP",
+        )
+        # dst is in ns y: pod peer (policy-ns x) doesn't match; ip out of cidr
+        assert not policy.is_traffic_allowed(t).is_allowed
+
+
+NAMED_PORT_POLICY = """
+apiVersion: networking.k8s.io/v1
+kind: NetworkPolicy
+metadata:
+  name: abc
+  namespace: x
+spec:
+  ingress:
+  - ports:
+    - port: port-hello
+      protocol: TCP
+  podSelector:
+    matchLabels:
+      pod: a
+  policyTypes:
+  - Ingress
+"""
+
+
+class TestNamedPort:
+    # policy_tests.go:182-222
+    def test_allows_named_port(self):
+        policy = build_network_policies(
+            True, load_policies_from_yaml(NAMED_PORT_POLICY)
+        )
+        t = Traffic(
+            source=TrafficPeer(ip="1.2.3.4"),
+            destination=internal("x", {"pod": "a"}, {"ns": "x"}, ip="192.168.242.249"),
+            resolved_port=0,
+            resolved_port_name="port-hello",
+            protocol="TCP",
+        )
+        assert policy.is_traffic_allowed(t).is_allowed
+
+    def test_denies_wrong_named_port(self):
+        policy = build_network_policies(
+            True, load_policies_from_yaml(NAMED_PORT_POLICY)
+        )
+        t = Traffic(
+            source=TrafficPeer(ip="1.2.3.4"),
+            destination=internal("x", {"pod": "a"}, {"ns": "x"}, ip="192.168.242.249"),
+            resolved_port=0,
+            resolved_port_name="port-goodbye",
+            protocol="TCP",
+        )
+        assert not policy.is_traffic_allowed(t).is_allowed
+
+
+class TestAllowRules:
+    def test_no_matching_target_allows(self):
+        # policy.go:157-160: no targets at all => allow everything
+        policy = build_network_policies(True, [])
+        t = Traffic(
+            source=internal("y"),
+            destination=internal("x", ip="1.2.3.5"),
+            resolved_port=80,
+            protocol="TCP",
+        )
+        assert policy.is_traffic_allowed(t).is_allowed
+
+    def test_external_destination_allows_ingress(self):
+        # policy.go:149-153: external target => allow (that direction)
+        policy = build_network_policies(
+            True, load_policies_from_yaml(ALLOW_ALL_ON_SCTP)
+        )
+        t = Traffic(
+            source=internal("x"),
+            destination=TrafficPeer(ip="8.8.8.8"),
+            resolved_port=80,
+            protocol="TCP",
+        )
+        result = policy.is_traffic_allowed(t)
+        assert result.ingress.is_allowed
+        # egress: no egress targets => allowed too
+        assert result.is_allowed
+
+    def test_target_combining(self):
+        # policy.go:51-66: same (ns, selector) targets combine peers
+        yaml_text = """
+apiVersion: networking.k8s.io/v1
+kind: NetworkPolicy
+metadata:
+  name: allow-from-y
+  namespace: x
+spec:
+  podSelector: {}
+  ingress:
+  - from:
+    - namespaceSelector:
+        matchLabels: {ns: y}
+  policyTypes:
+  - Ingress
+---
+apiVersion: networking.k8s.io/v1
+kind: NetworkPolicy
+metadata:
+  name: allow-from-z
+  namespace: x
+spec:
+  podSelector: {}
+  ingress:
+  - from:
+    - namespaceSelector:
+        matchLabels: {ns: z}
+  policyTypes:
+  - Ingress
+"""
+        policy = build_network_policies(True, load_policies_from_yaml(yaml_text))
+        assert len(policy.ingress) == 1
+        for src_ns in ("y", "z"):
+            t = Traffic(
+                source=internal(src_ns, ns_labels={"ns": src_ns}),
+                destination=internal("x", ip="1.2.3.5"),
+                resolved_port=80,
+                protocol="TCP",
+            )
+            assert policy.is_traffic_allowed(t).is_allowed, src_ns
+        t = Traffic(
+            source=internal("w", ns_labels={"ns": "w"}),
+            destination=internal("x", ip="1.2.3.5"),
+            resolved_port=80,
+            protocol="TCP",
+        )
+        assert not policy.is_traffic_allowed(t).is_allowed

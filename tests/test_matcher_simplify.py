@@ -1,0 +1,162 @@
+"""L2 tests: matcher canonicalization algebra
+(in the spirit of the reference's matcher/simplifier_tests.go)."""
+
+from cyclonus_tpu.kube.netpol import IntOrString, LabelSelector
+from cyclonus_tpu.matcher import (
+    ALL_PEERS_PORTS,
+    AllNamespaceMatcher,
+    AllPodMatcher,
+    AllPortMatcher,
+    ExactNamespaceMatcher,
+    IPPeerMatcher,
+    PodPeerMatcher,
+    PortProtocolMatcher,
+    PortsForAllPeersMatcher,
+    SpecificPortMatcher,
+    combine_port_matchers,
+    simplify,
+    subtract_port_matchers,
+)
+from cyclonus_tpu.kube.netpol import IPBlock
+
+
+def specific(*port_protos):
+    return SpecificPortMatcher(
+        ports=[
+            PortProtocolMatcher(
+                port=IntOrString(p) if p is not None else None, protocol=proto
+            )
+            for p, proto in port_protos
+        ]
+    )
+
+
+class TestCombinePortMatchers:
+    def test_all_wins(self):
+        assert isinstance(
+            combine_port_matchers(AllPortMatcher(), specific((80, "TCP"))),
+            AllPortMatcher,
+        )
+        assert isinstance(
+            combine_port_matchers(specific((80, "TCP")), AllPortMatcher()),
+            AllPortMatcher,
+        )
+
+    def test_specific_union_replicates_reference_dedup_bug(self):
+        # portmatcher.go:102-111's dedup loop appends the incoming port at
+        # every non-equal element until an equal one breaks — so 80 (equal at
+        # index 0) is dropped, while 82 is appended twice (once per non-equal
+        # element of [80, 81]).  Wart replicated for oracle parity; duplicates
+        # are harmless for evaluation (OR semantics).
+        a = specific((80, "TCP"), (81, "TCP"))
+        b = specific((80, "TCP"), (82, "TCP"))
+        combined = combine_port_matchers(a, b)
+        vals = [(p.port.value, p.protocol) for p in combined.ports]
+        assert vals == [(80, "TCP"), (81, "TCP"), (82, "TCP"), (82, "TCP")]
+
+    def test_combine_into_empty_drops_other_ports(self):
+        # The drop half of the same reference wart: when self.ports is empty
+        # the inner loop never runs, so other's ports vanish
+        # (portmatcher.go:104-111).
+        a = SpecificPortMatcher()
+        b = specific((80, "TCP"))
+        combined = combine_port_matchers(a, b)
+        assert combined.ports == []
+
+    def test_sort_order_nil_string_int(self):
+        a = SpecificPortMatcher(
+            ports=[
+                PortProtocolMatcher(port=IntOrString(99), protocol="TCP"),
+                PortProtocolMatcher(port=None, protocol="UDP"),
+                PortProtocolMatcher(port=IntOrString("zzz"), protocol="TCP"),
+            ]
+        )
+        combined = a.combine(SpecificPortMatcher())
+        kinds = [
+            (p.port is None, None if p.port is None else p.port.value)
+            for p in combined.ports
+        ]
+        assert kinds == [(True, None), (False, "zzz"), (False, 99)]
+
+
+class TestSubtractPortMatchers:
+    def test_all_minus_all_is_empty(self):
+        empty, rest = subtract_port_matchers(AllPortMatcher(), AllPortMatcher())
+        assert empty and rest is None
+
+    def test_all_minus_specific_is_all(self):
+        # the reference wart: all-but is not handled (simplifier.go:151-153)
+        empty, rest = subtract_port_matchers(AllPortMatcher(), specific((80, "TCP")))
+        assert not empty
+        assert isinstance(rest, AllPortMatcher)
+
+    def test_specific_minus_all_is_empty(self):
+        empty, rest = subtract_port_matchers(specific((80, "TCP")), AllPortMatcher())
+        assert empty and rest is None
+
+    def test_specific_minus_specific(self):
+        a = specific((80, "TCP"), (81, "TCP"))
+        b = specific((80, "TCP"))
+        empty, rest = subtract_port_matchers(a, b)
+        assert not empty
+        assert [(p.port.value, p.protocol) for p in rest.ports] == [(81, "TCP")]
+
+
+class TestSimplify:
+    def test_all_peers_collapses_everything(self):
+        pod = PodPeerMatcher(
+            namespace=AllNamespaceMatcher(),
+            pod=AllPodMatcher(),
+            port=AllPortMatcher(),
+        )
+        result = simplify([ALL_PEERS_PORTS, pod])
+        assert result == [ALL_PEERS_PORTS]
+
+    def test_merge_same_pod_matchers_unions_ports(self):
+        ns = ExactNamespaceMatcher(namespace="x")
+        a = PodPeerMatcher(namespace=ns, pod=AllPodMatcher(), port=specific((80, "TCP")))
+        b = PodPeerMatcher(namespace=ns, pod=AllPodMatcher(), port=specific((81, "TCP")))
+        result = simplify([a, b])
+        assert len(result) == 1
+        ports = [(p.port.value, p.protocol) for p in result[0].port.ports]
+        assert ports == [(80, "TCP"), (81, "TCP")]
+
+    def test_different_pod_matchers_not_merged(self):
+        a = PodPeerMatcher(
+            namespace=ExactNamespaceMatcher(namespace="x"),
+            pod=AllPodMatcher(),
+            port=AllPortMatcher(),
+        )
+        b = PodPeerMatcher(
+            namespace=ExactNamespaceMatcher(namespace="y"),
+            pod=AllPodMatcher(),
+            port=AllPortMatcher(),
+        )
+        assert len(simplify([a, b])) == 2
+
+    def test_ip_matchers_merge_by_primary_key(self):
+        blk = IPBlock.make(cidr="10.0.0.0/24")
+        a = IPPeerMatcher(ip_block=blk, port=specific((80, "TCP")))
+        b = IPPeerMatcher(ip_block=blk, port=specific((81, "TCP")))
+        result = simplify([a, b])
+        assert len(result) == 1
+        assert len(result[0].port.ports) == 2
+
+    def test_ports_for_all_subtracts_from_pods(self):
+        # simplifier.go:87-114: pod matcher covered by all-peers-port drops out
+        all_80 = PortsForAllPeersMatcher(port=specific((80, "TCP")))
+        pod_80 = PodPeerMatcher(
+            namespace=ExactNamespaceMatcher(namespace="x"),
+            pod=AllPodMatcher(),
+            port=specific((80, "TCP")),
+        )
+        result = simplify([all_80, pod_80])
+        assert len(result) == 1
+        assert isinstance(result[0], PortsForAllPeersMatcher)
+
+    def test_ports_for_all_merge(self):
+        a = PortsForAllPeersMatcher(port=specific((80, "TCP")))
+        b = PortsForAllPeersMatcher(port=specific((81, "TCP")))
+        result = simplify([a, b])
+        assert len(result) == 1
+        assert len(result[0].port.ports) == 2
