@@ -1,0 +1,24 @@
+"""The TPU engine: compiles a resolved matcher Policy + cluster model into
+dense tensors and evaluates the full ingress+egress verdict grid as JAX
+kernels (reference counterpart: the sequential loop in
+pkg/connectivity/probe/jobrunner.go:68-94 + pkg/matcher/policy.go:131-174).
+
+Pipeline:
+  encoding.py  - host-side tensor compiler (numpy): vocab-encode labels,
+                 selectors, targets, peers, port specs
+  kernel.py    - jit/vmap verdict kernels (single device)
+  sharded.py   - Mesh + shard_map source-axis-sharded evaluation
+  TpuPolicyEngine - the user-facing facade
+"""
+
+from .encoding import ClusterEncoding, PolicyEncoding, encode_cluster, encode_policy
+from .api import TpuPolicyEngine, PortCase
+
+__all__ = [
+    "ClusterEncoding",
+    "PolicyEncoding",
+    "encode_cluster",
+    "encode_policy",
+    "TpuPolicyEngine",
+    "PortCase",
+]

@@ -1,0 +1,534 @@
+"""Host-side tensor compiler: matcher IR + cluster model -> dense numpy
+arrays ready for the TPU kernels.
+
+Encoding scheme (see SURVEY.md section 7 step 3):
+  * label vocabulary: every distinct (key, value) pair appearing on any pod,
+    namespace, or selector gets an int id; every distinct key gets a key id.
+  * pods: (namespace id, padded kv-id list, padded key-id list, IPv4 uint32);
+    namespaces: (padded kv-id list, padded key-id list).
+  * selectors: deduped; matchLabels as padded required-kv ids, up to E
+    matchExpressions each (op, key id, padded value-kv ids).
+  * targets: (namespace id, selector id) per direction.
+  * peers: flat arrays with a target id and a kind code
+    (ALL / ALL_PORTS / IP / POD); pod peers carry namespace-matcher and
+    pod-matcher codes; ip peers carry premasked (base, mask) plus excepts.
+  * port specs: per peer, up to I single items (nil/int/named x protocol)
+    and R ranges.
+
+Padding is provably neutral: padded kv ids are -1 (never equal to a real
+id), padded expressions are op NONE (always true), padded peers belong to
+target -1 (one-hot row of zeros), padded except-blocks carry valid=False.
+
+Ragged semantics warning: everything here must mirror the scalar oracle in
+cyclonus_tpu.matcher exactly — any divergence is caught by the parity tests
+(tests/test_engine_parity.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..kube.ipaddr import cidr_to_base_and_prefix, ip_to_uint32
+from ..kube.netpol import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    LabelSelector,
+)
+from ..kube.labels import serialize_label_selector
+from ..matcher.core import (
+    AllNamespaceMatcher,
+    AllPeersMatcher,
+    AllPodMatcher,
+    AllPortMatcher,
+    ExactNamespaceMatcher,
+    IPPeerMatcher,
+    LabelSelectorNamespaceMatcher,
+    LabelSelectorPodMatcher,
+    PodPeerMatcher,
+    Policy,
+    PortsForAllPeersMatcher,
+    SpecificPortMatcher,
+)
+
+# selector expression opcodes
+EXP_NONE = 0
+EXP_IN = 1
+EXP_NOT_IN = 2
+EXP_EXISTS = 3
+EXP_DOES_NOT_EXIST = 4
+
+_OP_CODES = {
+    OP_IN: EXP_IN,
+    OP_NOT_IN: EXP_NOT_IN,
+    OP_EXISTS: EXP_EXISTS,
+    OP_DOES_NOT_EXIST: EXP_DOES_NOT_EXIST,
+}
+
+# peer kinds
+PEER_ALL = 0  # AllPeersMatcher: everything
+PEER_ALL_PORTS = 1  # PortsForAllPeersMatcher: any peer, port-matched
+PEER_IP = 2  # IPPeerMatcher
+PEER_POD = 3  # PodPeerMatcher
+
+# namespace-matcher kinds (within a pod peer)
+NS_EXACT = 0
+NS_SELECTOR = 1
+NS_ALL = 2
+
+# pod-matcher kinds
+POD_ALL = 0
+POD_SELECTOR = 1
+
+# port item kinds
+PORT_NIL = 0  # protocol only
+PORT_INT = 1
+PORT_NAMED = 2
+
+# protocols: TCP/UDP/SCTP preseeded; unknown protocol strings appearing in
+# policies get fresh ids at encode time so that equal strings still match
+# (the oracle compares protocol strings for equality — matcher/core.py).
+
+
+@dataclass
+class _Vocab:
+    kv: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    key: Dict[str, int] = field(default_factory=dict)
+    ns: Dict[str, int] = field(default_factory=dict)
+    port_name: Dict[str, int] = field(default_factory=dict)
+    proto: Dict[str, int] = field(
+        default_factory=lambda: {"TCP": 0, "UDP": 1, "SCTP": 2}
+    )
+
+    def kv_id(self, k: str, v: str) -> int:
+        return self.kv.setdefault((k, v), len(self.kv))
+
+    def key_id(self, k: str) -> int:
+        return self.key.setdefault(k, len(self.key))
+
+    def ns_id(self, ns: str) -> int:
+        return self.ns.setdefault(ns, len(self.ns))
+
+    def port_name_id(self, name: str) -> int:
+        if name == "":
+            return -1
+        return self.port_name.setdefault(name, len(self.port_name))
+
+    def proto_id(self, protocol: str) -> int:
+        return self.proto.setdefault(protocol, len(self.proto))
+
+
+@dataclass
+class ClusterEncoding:
+    """Tensorized cluster: one row per pod, one row per namespace."""
+
+    vocab: _Vocab
+    pod_keys: List[str]  # "ns/name" in row order
+    pod_ns_id: np.ndarray  # [N] int32
+    pod_kv: np.ndarray  # [N, L] int32, pad -1
+    pod_key: np.ndarray  # [N, L] int32, pad -1
+    pod_ip: np.ndarray  # [N] uint32 (0 where invalid)
+    pod_ip_valid: np.ndarray  # [N] bool (parseable IPv4)
+    pod_ips: List[str]  # raw strings, for host-side v6 fallback
+    ns_kv: np.ndarray  # [M, Lns] int32
+    ns_key: np.ndarray  # [M, Lns] int32
+
+    @property
+    def n_pods(self) -> int:
+        return len(self.pod_keys)
+
+
+def _encode_label_rows(
+    label_maps: Sequence[Dict[str, str]], vocab: _Vocab
+) -> Tuple[np.ndarray, np.ndarray]:
+    max_l = max((len(m) for m in label_maps), default=0)
+    max_l = max(max_l, 1)
+    kv = np.full((len(label_maps), max_l), -1, dtype=np.int32)
+    key = np.full((len(label_maps), max_l), -1, dtype=np.int32)
+    for i, m in enumerate(label_maps):
+        for j, (k, v) in enumerate(sorted(m.items())):
+            kv[i, j] = vocab.kv_id(k, v)
+            key[i, j] = vocab.key_id(k)
+    return kv, key
+
+
+def encode_cluster(
+    pods: Sequence[Tuple[str, str, Dict[str, str], str]],
+    namespaces: Dict[str, Dict[str, str]],
+    vocab: Optional[_Vocab] = None,
+) -> ClusterEncoding:
+    """pods: (namespace, name, labels, ip) per pod.
+    namespaces: ns -> labels.
+
+    The namespace-label rows are indexed BY VOCAB NS ID (the vocab may
+    already hold ids for policy-target namespaces, and pods may live in
+    namespaces absent from the dict) — a namespace with no labels entry gets
+    an all-pad row, matching the oracle's empty-label semantics for unknown
+    namespaces."""
+    vocab = vocab or _Vocab()
+    for ns in namespaces:
+        vocab.ns_id(ns)
+    for p in pods:
+        vocab.ns_id(p[0])
+    n_ns = len(vocab.ns)
+    label_rows: List[Dict[str, str]] = [{} for _ in range(n_ns)]
+    for ns, labels in namespaces.items():
+        label_rows[vocab.ns_id(ns)] = labels
+    ns_kv, ns_key = _encode_label_rows(label_rows, vocab)
+
+    pod_ns_id = np.array(
+        [vocab.ns_id(p[0]) for p in pods], dtype=np.int32
+    ) if pods else np.zeros((0,), dtype=np.int32)
+    pod_kv, pod_key = _encode_label_rows([p[2] for p in pods], vocab)
+    ips = [p[3] for p in pods]
+    ip_ints = [ip_to_uint32(ip) for ip in ips]
+    pod_ip = np.array([i or 0 for i in ip_ints], dtype=np.uint32)
+    pod_ip_valid = np.array([i is not None for i in ip_ints], dtype=bool)
+    return ClusterEncoding(
+        vocab=vocab,
+        pod_keys=[f"{p[0]}/{p[1]}" for p in pods],
+        pod_ns_id=pod_ns_id,
+        pod_kv=pod_kv,
+        pod_key=pod_key,
+        pod_ip=pod_ip,
+        pod_ip_valid=pod_ip_valid,
+        pod_ips=list(ips),
+        ns_kv=ns_kv,
+        ns_key=ns_key,
+    )
+
+
+@dataclass
+class _SelectorTable:
+    """Deduped selectors encoded as fixed-width arrays."""
+
+    index: Dict[str, int] = field(default_factory=dict)
+    selectors: List[LabelSelector] = field(default_factory=list)
+
+    def sel_id(self, selector: LabelSelector) -> int:
+        key = serialize_label_selector(selector)
+        if key not in self.index:
+            self.index[key] = len(self.selectors)
+            self.selectors.append(selector)
+        return self.index[key]
+
+    def encode(self, vocab: _Vocab):
+        n = len(self.selectors)
+        max_r = max((len(s.match_labels_items) for s in self.selectors), default=0)
+        max_e = max((len(s.match_expressions) for s in self.selectors), default=0)
+        max_v = max(
+            (
+                len(e.values)
+                for s in self.selectors
+                for e in s.match_expressions
+            ),
+            default=0,
+        )
+        max_r, max_e, max_v = max(max_r, 1), max(max_e, 1), max(max_v, 1)
+        req_kv = np.full((n, max_r), -1, dtype=np.int32)
+        exp_op = np.full((n, max_e), EXP_NONE, dtype=np.int32)
+        exp_key = np.full((n, max_e), -1, dtype=np.int32)
+        exp_vals = np.full((n, max_e, max_v), -1, dtype=np.int32)
+        for i, s in enumerate(self.selectors):
+            for j, (k, v) in enumerate(s.match_labels_items):
+                req_kv[i, j] = vocab.kv_id(k, v)
+            for j, e in enumerate(s.match_expressions):
+                exp_op[i, j] = _OP_CODES[e.operator]
+                exp_key[i, j] = vocab.key_id(e.key)
+                for vi, v in enumerate(e.values):
+                    exp_vals[i, j, vi] = vocab.kv_id(e.key, v)
+        return req_kv, exp_op, exp_key, exp_vals
+
+
+@dataclass
+class _PortSpecBuilder:
+    """Per-peer port spec rows."""
+
+    all_flag: List[bool] = field(default_factory=list)
+    items: List[List[Tuple[int, int, int, int]]] = field(default_factory=list)
+    # item: (kind, port_int, name_id, proto_id)
+    ranges: List[List[Tuple[int, int, int]]] = field(default_factory=list)
+    # range: (from, to, proto_id)
+
+    def add(self, port_matcher, vocab: _Vocab) -> None:
+        if isinstance(port_matcher, AllPortMatcher):
+            self.all_flag.append(True)
+            self.items.append([])
+            self.ranges.append([])
+            return
+        if not isinstance(port_matcher, SpecificPortMatcher):
+            raise TypeError(f"invalid PortMatcher type {type(port_matcher)}")
+        items = []
+        for pp in port_matcher.ports:
+            pid = vocab.proto_id(pp.protocol)
+            if pp.port is None:
+                items.append((PORT_NIL, 0, -1, pid))
+            elif pp.port.is_int:
+                items.append((PORT_INT, pp.port.int_value, -1, pid))
+            else:
+                items.append(
+                    (PORT_NAMED, 0, vocab.port_name_id(pp.port.str_value), pid)
+                )
+        ranges = [
+            (r.from_port, r.to_port, vocab.proto_id(r.protocol))
+            for r in port_matcher.port_ranges
+        ]
+        self.all_flag.append(False)
+        self.items.append(items)
+        self.ranges.append(ranges)
+
+    def encode(self):
+        n = len(self.all_flag)
+        max_i = max((len(x) for x in self.items), default=0)
+        max_r = max((len(x) for x in self.ranges), default=0)
+        max_i, max_r = max(max_i, 1), max(max_r, 1)
+        item_kind = np.full((n, max_i), -1, dtype=np.int32)  # -1 = pad, no match
+        item_port = np.zeros((n, max_i), dtype=np.int32)
+        item_name = np.full((n, max_i), -2, dtype=np.int32)  # -2 never equals -1
+        item_proto = np.full((n, max_i), -2, dtype=np.int32)
+        rng_from = np.zeros((n, max_r), dtype=np.int32)
+        rng_to = np.full((n, max_r), -1, dtype=np.int32)  # empty range
+        rng_proto = np.full((n, max_r), -2, dtype=np.int32)
+        for i in range(n):
+            for j, (kind, port, name, proto) in enumerate(self.items[i]):
+                item_kind[i, j] = kind
+                item_port[i, j] = port
+                item_name[i, j] = name
+                item_proto[i, j] = proto
+            for j, (f, t, proto) in enumerate(self.ranges[i]):
+                rng_from[i, j] = f
+                rng_to[i, j] = t
+                rng_proto[i, j] = proto
+        return {
+            "spec_all": np.array(self.all_flag, dtype=bool),
+            "item_kind": item_kind,
+            "item_port": item_port,
+            "item_name": item_name,
+            "item_proto": item_proto,
+            "rng_from": rng_from,
+            "rng_to": rng_to,
+            "rng_proto": rng_proto,
+        }
+
+
+@dataclass
+class _DirectionEncoding:
+    """Targets + flattened peers for one direction (ingress or egress)."""
+
+    n_targets: int
+    target_ns: np.ndarray  # [T] int32 (-1: namespace unknown to cluster)
+    target_sel: np.ndarray  # [T] int32 selector id
+    # peers, flat:
+    peer_target: np.ndarray  # [P] int32
+    peer_kind: np.ndarray  # [P] int32
+    peer_ns_kind: np.ndarray  # [P] int32 (pod peers)
+    peer_ns_id: np.ndarray  # [P] int32 (NS_EXACT)
+    peer_ns_sel: np.ndarray  # [P] int32 (NS_SELECTOR)
+    peer_pod_kind: np.ndarray  # [P] int32
+    peer_pod_sel: np.ndarray  # [P] int32
+    # ip peers (IPv4 in-kernel; v6 handled via host rows):
+    ip_base: np.ndarray  # [P] uint32 (pre-masked)
+    ip_mask: np.ndarray  # [P] uint32
+    ip_is_v4: np.ndarray  # [P] bool
+    ex_base: np.ndarray  # [P, X] uint32
+    ex_mask: np.ndarray  # [P, X] uint32
+    ex_valid: np.ndarray  # [P, X] bool
+    host_ip_rows: List[Tuple[int, IPPeerMatcher]]  # v6 fallback: peer row -> matcher
+    port_spec: Dict[str, np.ndarray]  # per-peer port spec arrays
+
+    @property
+    def n_peers(self) -> int:
+        return len(self.peer_target)
+
+
+def _mask_for_prefix(prefix: int) -> int:
+    return 0 if prefix == 0 else (0xFFFFFFFF << (32 - prefix)) & 0xFFFFFFFF
+
+
+def _encode_direction(
+    targets, sel_table: _SelectorTable, vocab: _Vocab
+) -> _DirectionEncoding:
+    t_ns, t_sel = [], []
+    p_target, p_kind = [], []
+    p_ns_kind, p_ns_id, p_ns_sel = [], [], []
+    p_pod_kind, p_pod_sel = [], []
+    ip_rows: List[Tuple[int, int, bool]] = []  # (base, mask, is_v4)
+    ex_rows: List[List[Tuple[int, int]]] = []
+    host_ip_rows: List[Tuple[int, IPPeerMatcher]] = []
+    specs = _PortSpecBuilder()
+
+    for t_idx, target in enumerate(targets):
+        # target namespace must match by name; namespaces not present in the
+        # cluster can't match any pod, but we register them in the vocab so
+        # equality against pod ns ids is well-defined either way.
+        t_ns.append(vocab.ns_id(target.namespace))
+        t_sel.append(sel_table.sel_id(target.pod_selector))
+        for peer in target.peers:
+            p_target.append(t_idx)
+            if isinstance(peer, AllPeersMatcher):
+                p_kind.append(PEER_ALL)
+                specs.add(AllPortMatcher(), vocab)
+                p_ns_kind.append(NS_ALL)
+                p_ns_id.append(-1)
+                p_ns_sel.append(-1)
+                p_pod_kind.append(POD_ALL)
+                p_pod_sel.append(-1)
+                ip_rows.append((0, 0, False))
+                ex_rows.append([])
+            elif isinstance(peer, PortsForAllPeersMatcher):
+                p_kind.append(PEER_ALL_PORTS)
+                specs.add(peer.port, vocab)
+                p_ns_kind.append(NS_ALL)
+                p_ns_id.append(-1)
+                p_ns_sel.append(-1)
+                p_pod_kind.append(POD_ALL)
+                p_pod_sel.append(-1)
+                ip_rows.append((0, 0, False))
+                ex_rows.append([])
+            elif isinstance(peer, IPPeerMatcher):
+                p_kind.append(PEER_IP)
+                specs.add(peer.port, vocab)
+                p_ns_kind.append(NS_ALL)
+                p_ns_id.append(-1)
+                p_ns_sel.append(-1)
+                p_pod_kind.append(POD_ALL)
+                p_pod_sel.append(-1)
+                bp = cidr_to_base_and_prefix(peer.ip_block.cidr)
+                if bp is None:
+                    # IPv6 CIDR: evaluate host-side (rare), kernel row inert
+                    ip_rows.append((0, 0, False))
+                    ex_rows.append([])
+                    host_ip_rows.append((len(p_target) - 1, peer))
+                else:
+                    base, prefix = bp
+                    mask = _mask_for_prefix(prefix)
+                    ip_rows.append((base & mask, mask, True))
+                    exs = []
+                    v6_except = False
+                    for ex in peer.ip_block.except_:
+                        ebp = cidr_to_base_and_prefix(ex)
+                        if ebp is None:
+                            v6_except = True
+                            continue
+                        ebase, eprefix = ebp
+                        emask = _mask_for_prefix(eprefix)
+                        exs.append((ebase & emask, emask))
+                    if v6_except:
+                        # mixed-family excepts: fall back to host eval for
+                        # exactness
+                        ip_rows[-1] = (0, 0, False)
+                        exs = []
+                        host_ip_rows.append((len(p_target) - 1, peer))
+                    ex_rows.append(exs)
+            elif isinstance(peer, PodPeerMatcher):
+                p_kind.append(PEER_POD)
+                specs.add(peer.port, vocab)
+                ns = peer.namespace
+                if isinstance(ns, ExactNamespaceMatcher):
+                    p_ns_kind.append(NS_EXACT)
+                    p_ns_id.append(vocab.ns_id(ns.namespace))
+                    p_ns_sel.append(-1)
+                elif isinstance(ns, LabelSelectorNamespaceMatcher):
+                    p_ns_kind.append(NS_SELECTOR)
+                    p_ns_id.append(-1)
+                    p_ns_sel.append(sel_table.sel_id(ns.selector))
+                elif isinstance(ns, AllNamespaceMatcher):
+                    p_ns_kind.append(NS_ALL)
+                    p_ns_id.append(-1)
+                    p_ns_sel.append(-1)
+                else:
+                    raise TypeError(f"invalid NamespaceMatcher {type(ns)}")
+                pod = peer.pod
+                if isinstance(pod, AllPodMatcher):
+                    p_pod_kind.append(POD_ALL)
+                    p_pod_sel.append(-1)
+                elif isinstance(pod, LabelSelectorPodMatcher):
+                    p_pod_kind.append(POD_SELECTOR)
+                    p_pod_sel.append(sel_table.sel_id(pod.selector))
+                else:
+                    raise TypeError(f"invalid PodMatcher {type(pod)}")
+                ip_rows.append((0, 0, False))
+                ex_rows.append([])
+            else:
+                raise TypeError(f"invalid PeerMatcher type {type(peer)}")
+
+    n_p = len(p_target)
+    max_x = max((len(x) for x in ex_rows), default=0)
+    max_x = max(max_x, 1)
+    ex_base = np.zeros((n_p, max_x), dtype=np.uint32)
+    ex_mask = np.zeros((n_p, max_x), dtype=np.uint32)
+    ex_valid = np.zeros((n_p, max_x), dtype=bool)
+    for i, exs in enumerate(ex_rows):
+        for j, (b, m) in enumerate(exs):
+            ex_base[i, j] = b
+            ex_mask[i, j] = m
+            ex_valid[i, j] = True
+
+    return _DirectionEncoding(
+        n_targets=len(t_ns),
+        target_ns=np.array(t_ns, dtype=np.int32).reshape(-1),
+        target_sel=np.array(t_sel, dtype=np.int32).reshape(-1),
+        peer_target=np.array(p_target, dtype=np.int32).reshape(-1),
+        peer_kind=np.array(p_kind, dtype=np.int32).reshape(-1),
+        peer_ns_kind=np.array(p_ns_kind, dtype=np.int32).reshape(-1),
+        peer_ns_id=np.array(p_ns_id, dtype=np.int32).reshape(-1),
+        peer_ns_sel=np.array(p_ns_sel, dtype=np.int32).reshape(-1),
+        peer_pod_kind=np.array(p_pod_kind, dtype=np.int32).reshape(-1),
+        peer_pod_sel=np.array(p_pod_sel, dtype=np.int32).reshape(-1),
+        ip_base=np.array([r[0] for r in ip_rows], dtype=np.uint32).reshape(-1),
+        ip_mask=np.array([r[1] for r in ip_rows], dtype=np.uint32).reshape(-1),
+        ip_is_v4=np.array([r[2] for r in ip_rows], dtype=bool).reshape(-1),
+        ex_base=ex_base,
+        ex_mask=ex_mask,
+        ex_valid=ex_valid,
+        host_ip_rows=host_ip_rows,
+        port_spec=specs.encode(),
+    )
+
+
+@dataclass
+class PolicyEncoding:
+    """Full tensor encoding of a compiled Policy against a cluster."""
+
+    cluster: ClusterEncoding
+    ingress: _DirectionEncoding
+    egress: _DirectionEncoding
+    # selector arrays (shared by both directions):
+    sel_req_kv: np.ndarray
+    sel_exp_op: np.ndarray
+    sel_exp_key: np.ndarray
+    sel_exp_vals: np.ndarray
+    n_selectors: int
+
+
+def encode_policy(
+    policy: Policy,
+    pods: Sequence[Tuple[str, str, Dict[str, str], str]],
+    namespaces: Dict[str, Dict[str, str]],
+) -> PolicyEncoding:
+    """Compile (policy, cluster) to tensors.  The selector/label vocabulary
+    is built jointly so every selector-referenced pair has an id."""
+    vocab = _Vocab()
+    sel_table = _SelectorTable()
+
+    ingress_targets, egress_targets = policy.sorted_targets()
+    ingress = _encode_direction(ingress_targets, sel_table, vocab)
+    egress = _encode_direction(egress_targets, sel_table, vocab)
+
+    cluster = encode_cluster(pods, namespaces, vocab=vocab)
+
+    sel_req_kv, sel_exp_op, sel_exp_key, sel_exp_vals = sel_table.encode(vocab)
+    return PolicyEncoding(
+        cluster=cluster,
+        ingress=ingress,
+        egress=egress,
+        sel_req_kv=sel_req_kv,
+        sel_exp_op=sel_exp_op,
+        sel_exp_key=sel_exp_key,
+        sel_exp_vals=sel_exp_vals,
+        n_selectors=len(sel_table.selectors),
+    )

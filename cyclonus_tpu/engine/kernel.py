@@ -1,0 +1,280 @@
+"""JAX verdict kernels (single-device path; sharded.py wraps these with
+shard_map over a Mesh).
+
+The decision procedure mirrors matcher/core.py (and thus the reference's
+policy.go:138-174), restructured for the MXU:
+
+  per direction d in {ingress, egress}:
+    selpod[S, N]      selector s matches pod n's labels        (int compares)
+    tmatch[T, N]      target t applies to pod n                (ns eq AND sel)
+    peer_match[P, N]  peer p matches pod n (ports aside)       (kind switch)
+    pport[P, Q]       peer p's port spec allows port case q    (int compares)
+    peer_allow[P,N,Q] = peer_match & pport
+    tallow[T, N, Q]   = one_hot(peer->target) @ peer_allow     <- MXU matmul
+    any_allow[n,m,Q]  = tmatch^T @ tallow                      <- MXU matmul
+    allowed[n, m, q]  = NOT has_target[n] OR any_allow > 0
+
+  combined[s, d, q] = egress_allowed[s, d, q] AND ingress_allowed[d, s, q]
+
+All tensors are boolean/integer; matmuls run in bfloat16 with float32
+accumulation, so the >0 threshold is exact (counts are small positive
+integers, never rounded to zero).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .encoding import (
+    EXP_DOES_NOT_EXIST,
+    EXP_EXISTS,
+    EXP_IN,
+    EXP_NONE,
+    EXP_NOT_IN,
+    NS_ALL,
+    NS_EXACT,
+    NS_SELECTOR,
+    PEER_ALL,
+    PEER_ALL_PORTS,
+    PEER_IP,
+    PEER_POD,
+    POD_SELECTOR,
+    PORT_INT,
+    PORT_NAMED,
+    PORT_NIL,
+)
+
+
+def selector_match(
+    sel_req_kv: jnp.ndarray,  # [S, R]
+    sel_exp_op: jnp.ndarray,  # [S, E]
+    sel_exp_key: jnp.ndarray,  # [S, E]
+    sel_exp_vals: jnp.ndarray,  # [S, E, V]
+    kv: jnp.ndarray,  # [N, L]
+    key: jnp.ndarray,  # [N, L]
+) -> jnp.ndarray:
+    """[S, N] bool: selector s matches label-set n.
+    Mirrors kube/labels.py is_labels_match_label_selector."""
+    # matchLabels: every non-pad required kv id must be present
+    # present[S, N, R] = any_L(kv[n, l] == req[s, r])
+    present = jnp.any(
+        kv[None, :, None, :] == sel_req_kv[:, None, :, None], axis=-1
+    )
+    req_ok = jnp.all((sel_req_kv[:, None, :] == -1) | present, axis=-1)  # [S, N]
+
+    # matchExpressions
+    has_key = jnp.any(
+        key[None, :, None, :] == sel_exp_key[:, None, :, None], axis=-1
+    )  # [S, N, E]
+    val_hit = jnp.any(
+        (sel_exp_vals[:, None, :, :, None] != -1)
+        & (kv[None, :, None, None, :] == sel_exp_vals[:, None, :, :, None]),
+        axis=(-1, -2),
+    )  # [S, N, E]
+    op = sel_exp_op[:, None, :]  # [S, 1, E]
+    exp_ok = jnp.where(
+        op == EXP_NONE,
+        True,
+        jnp.where(
+            op == EXP_IN,
+            has_key & val_hit,
+            jnp.where(
+                op == EXP_NOT_IN,
+                has_key & ~val_hit,
+                jnp.where(op == EXP_EXISTS, has_key, ~has_key),
+            ),
+        ),
+    )  # [S, N, E]
+    return req_ok & jnp.all(exp_ok, axis=-1)
+
+
+def direction_precompute(
+    enc: Dict[str, jnp.ndarray],
+    selpod: jnp.ndarray,  # [S, N] selector-vs-pod-labels
+    selns: jnp.ndarray,  # [S, M] selector-vs-namespace-labels
+    pod_ns_id: jnp.ndarray,  # [N]
+    pod_ip: jnp.ndarray,  # [N] uint32
+    pod_ip_valid: jnp.ndarray,  # [N] bool
+) -> Dict[str, jnp.ndarray]:
+    """Per-direction pod-resolution: tmatch[T, N], has_target[N],
+    peer_match[P, N]."""
+    # targets: namespace name equality + pod selector
+    tmatch = (enc["target_ns"][:, None] == pod_ns_id[None, :]) & jnp.take(
+        selpod, enc["target_sel"], axis=0
+    )  # [T, N]
+    has_target = jnp.any(tmatch, axis=0)  # [N]
+
+    # pod-peer namespace matching
+    ns_sel_match = jnp.take(
+        selns, jnp.maximum(enc["peer_ns_sel"], 0), axis=0
+    )  # [P, M] (garbage rows masked by kind below)
+    ns_match_by_pod = jnp.take(ns_sel_match, pod_ns_id, axis=1)  # [P, N]
+    ns_kind = enc["peer_ns_kind"][:, None]
+    ns_ok = jnp.where(
+        ns_kind == NS_EXACT,
+        enc["peer_ns_id"][:, None] == pod_ns_id[None, :],
+        jnp.where(ns_kind == NS_SELECTOR, ns_match_by_pod, True),
+    )  # [P, N]
+
+    # pod-peer pod matching
+    pod_sel_match = jnp.take(
+        selpod, jnp.maximum(enc["peer_pod_sel"], 0), axis=0
+    )  # [P, N]
+    pod_ok = jnp.where(
+        enc["peer_pod_kind"][:, None] == POD_SELECTOR, pod_sel_match, True
+    )
+
+    # ip peers (IPv4 kernel; v6 rows are patched host-side)
+    in_cidr = (
+        enc["ip_is_v4"][:, None]
+        & pod_ip_valid[None, :]
+        & ((pod_ip[None, :] & enc["ip_mask"][:, None]) == enc["ip_base"][:, None])
+    )  # [P, N]
+    in_except = jnp.any(
+        enc["ex_valid"][:, :, None]
+        & (
+            (pod_ip[None, None, :] & enc["ex_mask"][:, :, None])
+            == enc["ex_base"][:, :, None]
+        ),
+        axis=1,
+    )  # [P, N]
+    ip_ok = in_cidr & ~in_except
+
+    kind = enc["peer_kind"][:, None]
+    peer_match = jnp.where(
+        (kind == PEER_ALL) | (kind == PEER_ALL_PORTS),
+        True,
+        jnp.where(kind == PEER_IP, ip_ok, ns_ok & pod_ok),
+    )  # [P, N]
+
+    return {"tmatch": tmatch, "has_target": has_target, "peer_match": peer_match}
+
+
+def port_spec_allows(
+    spec: Dict[str, jnp.ndarray],
+    q_port: jnp.ndarray,  # [Q] int32
+    q_name: jnp.ndarray,  # [Q] int32 (-1: unnamed)
+    q_proto: jnp.ndarray,  # [Q] int32
+) -> jnp.ndarray:
+    """[P, Q] bool: peer p's port matcher allows port case q.
+    Mirrors matcher/core.py SpecificPortMatcher.allows / AllPortMatcher."""
+    kind = spec["item_kind"][:, :, None]  # [P, I, 1]
+    proto_ok = spec["item_proto"][:, :, None] == q_proto[None, None, :]
+    item_ok = jnp.where(
+        kind == PORT_NIL,
+        proto_ok,
+        jnp.where(
+            kind == PORT_INT,
+            (spec["item_port"][:, :, None] == q_port[None, None, :]) & proto_ok,
+            jnp.where(
+                kind == PORT_NAMED,
+                (spec["item_name"][:, :, None] == q_name[None, None, :]) & proto_ok,
+                False,  # pad
+            ),
+        ),
+    )  # [P, I, Q]
+    rng_ok = (
+        (spec["rng_from"][:, :, None] <= q_port[None, None, :])
+        & (q_port[None, None, :] <= spec["rng_to"][:, :, None])
+        & (spec["rng_proto"][:, :, None] == q_proto[None, None, :])
+    )  # [P, R, Q]
+    any_ok = jnp.any(item_ok, axis=1) | jnp.any(rng_ok, axis=1)
+    return spec["spec_all"][:, None] | any_ok  # [P, Q]
+
+
+def _bool_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a @ b) > 0 computed on the MXU: bf16 inputs, f32 accumulation."""
+    return (
+        jnp.matmul(
+            a.astype(jnp.bfloat16),
+            b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        > 0.0
+    )
+
+
+def direction_allowed(
+    tmatch_target: jnp.ndarray,  # [T, Nt] target-side pods
+    has_target: jnp.ndarray,  # [Nt]
+    m_tp: jnp.ndarray,  # [T, P] peer->target one-hot
+    peer_match: jnp.ndarray,  # [P, Np] peer-side pods
+    pport: jnp.ndarray,  # [P, Q]
+) -> jnp.ndarray:
+    """[Nt, Np, Q] bool: direction verdict for (target-side pod, peer-side
+    pod, port case)."""
+    n_p, n_np = peer_match.shape
+    q = pport.shape[1]
+    # peer_allow[P, Np*Q]
+    peer_allow = (peer_match[:, :, None] & pport[:, None, :]).reshape(n_p, n_np * q)
+    tallow = _bool_matmul(m_tp, peer_allow)  # [T, Np*Q]
+    any_allow = _bool_matmul(tmatch_target.T, tallow)  # [Nt, Np*Q]
+    allowed = (~has_target[:, None]) | any_allow
+    return allowed.reshape(-1, n_np, q)
+
+
+@partial(jax.jit, static_argnames=())
+def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
+    """Full-grid verdict on one device.
+
+    tensors: pytree with keys
+      sel_*: selector tables; pod_*: cluster pod arrays; ns_kv/ns_key;
+      ingress/egress: per-direction encodings (dicts incl. m_tp);
+      q_port/q_name/q_proto: [Q] port cases.
+    Returns ingress[d, s, q], egress[s, d, q], combined[s, d, q].
+    """
+    selpod = selector_match(
+        tensors["sel_req_kv"],
+        tensors["sel_exp_op"],
+        tensors["sel_exp_key"],
+        tensors["sel_exp_vals"],
+        tensors["pod_kv"],
+        tensors["pod_key"],
+    )
+    selns = selector_match(
+        tensors["sel_req_kv"],
+        tensors["sel_exp_op"],
+        tensors["sel_exp_key"],
+        tensors["sel_exp_vals"],
+        tensors["ns_kv"],
+        tensors["ns_key"],
+    )
+
+    out = {}
+    for direction in ("ingress", "egress"):
+        enc = tensors[direction]
+        pre = direction_precompute(
+            enc,
+            selpod,
+            selns,
+            tensors["pod_ns_id"],
+            tensors["pod_ip"],
+            tensors["pod_ip_valid"],
+        )
+        peer_match = pre["peer_match"]
+        if "host_ip_match" in enc:
+            # patch host-evaluated ip-peer rows (IPv6 fallback)
+            peer_match = jnp.where(
+                enc["host_ip_mask"][:, None], enc["host_ip_match"], peer_match
+            )
+        pport = port_spec_allows(
+            enc["port_spec"],
+            tensors["q_port"],
+            tensors["q_name"],
+            tensors["q_proto"],
+        )
+        out[direction] = direction_allowed(
+            pre["tmatch"], pre["has_target"], enc["m_tp"], peer_match, pport
+        )
+
+    # ingress is indexed [dst, src, q]; egress [src, dst, q]
+    combined = out["egress"] & jnp.swapaxes(out["ingress"], 0, 1)
+    return {
+        "ingress": out["ingress"],
+        "egress": out["egress"],
+        "combined": combined,
+    }

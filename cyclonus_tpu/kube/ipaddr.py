@@ -56,14 +56,18 @@ def make_ipv4_cidr(ip: str, bits: int) -> str:
 
 
 def ip_to_uint32(ip: str) -> Optional[int]:
-    """IPv4 address as uint32 for the tensor encoding; None for non-IPv4
-    (including unparseable placeholders like 'TODO')."""
+    """IPv4 address as uint32 for the tensor encoding; IPv4-mapped IPv6
+    (::ffff:a.b.c.d) normalizes to its IPv4 form like Go's To4 (and
+    is_ip_in_cidr above); None for other non-IPv4 and unparseable input."""
     try:
         addr = ipaddress.ip_address(ip)
     except ValueError:
         return None
-    if addr.version != 4:
-        return None
+    if addr.version == 6:
+        mapped = addr.ipv4_mapped
+        if mapped is None:
+            return None
+        addr = mapped
     return int(addr)
 
 

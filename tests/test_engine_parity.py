@@ -1,0 +1,645 @@
+"""THE parity gate: the TPU engine must reproduce the scalar oracle's
+verdicts exactly — 100% truth-table parity (BASELINE.json north star).
+
+Covers: the reference simple-example fixtures, selector operators, named
+ports, port ranges, ipblocks with excepts, protocol isolation, and a
+randomized policy/cluster fuzzer.  Both the single-device kernel and the
+8-virtual-device sharded path are checked.
+"""
+
+import random
+
+import pytest
+
+from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
+from cyclonus_tpu.kube.netpol import (
+    IPBlock,
+    IntOrString,
+    LabelSelector,
+    LabelSelectorRequirement,
+    NetworkPolicy,
+    NetworkPolicyEgressRule,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+)
+from cyclonus_tpu.kube.yaml_io import load_policies_from_path
+from cyclonus_tpu.matcher import (
+    InternalPeer,
+    Traffic,
+    TrafficPeer,
+    build_network_policies,
+)
+
+
+def oracle_grid(policy, pods, namespaces, cases):
+    """Reference evaluation: the scalar oracle over every (src, dst, case)."""
+    n = len(pods)
+    results = {}
+    for qi, case in enumerate(cases):
+        for si, (sns, sname, slabels, sip) in enumerate(pods):
+            for di, (dns, dname, dlabels, dip) in enumerate(pods):
+                t = Traffic(
+                    source=TrafficPeer(
+                        internal=InternalPeer(
+                            pod_labels=slabels,
+                            namespace_labels=namespaces.get(sns, {}),
+                            namespace=sns,
+                        ),
+                        ip=sip,
+                    ),
+                    destination=TrafficPeer(
+                        internal=InternalPeer(
+                            pod_labels=dlabels,
+                            namespace_labels=namespaces.get(dns, {}),
+                            namespace=dns,
+                        ),
+                        ip=dip,
+                    ),
+                    resolved_port=case.port,
+                    resolved_port_name=case.port_name,
+                    protocol=case.protocol,
+                )
+                r = policy.is_traffic_allowed(t)
+                results[(qi, si, di)] = (
+                    r.ingress.is_allowed,
+                    r.egress.is_allowed,
+                    r.is_allowed,
+                )
+    return results
+
+
+def assert_parity(policy, pods, namespaces, cases, sharded=False):
+    engine = TpuPolicyEngine(policy, pods, namespaces)
+    if sharded:
+        grid = engine.evaluate_grid_sharded(cases)
+    else:
+        grid = engine.evaluate_grid(cases)
+    expected = oracle_grid(policy, pods, namespaces, cases)
+    mismatches = []
+    for (qi, si, di), (exp_in, exp_eg, exp_comb) in expected.items():
+        got_in, got_eg, got_comb = grid.job_verdict(qi, si, di)
+        if (got_in, got_eg, got_comb) != (exp_in, exp_eg, exp_comb):
+            mismatches.append(
+                (cases[qi], engine.pod_keys[si], engine.pod_keys[di],
+                 (exp_in, exp_eg, exp_comb), (got_in, got_eg, got_comb))
+            )
+    assert not mismatches, f"{len(mismatches)} mismatches, first 5: {mismatches[:5]}"
+
+
+def default_cluster():
+    namespaces = {ns: {"ns": ns} for ns in ("x", "y", "z")}
+    pods = []
+    ip = 1
+    for ns in ("x", "y", "z"):
+        for name in ("a", "b", "c"):
+            pods.append((ns, name, {"pod": name}, f"192.168.1.{ip}"))
+            ip += 1
+    return pods, namespaces
+
+
+CASES_TCP80 = [PortCase(80, "serve-80-tcp", "TCP")]
+CASES_MULTI = [
+    PortCase(80, "serve-80-tcp", "TCP"),
+    PortCase(80, "serve-80-udp", "UDP"),
+    PortCase(81, "serve-81-tcp", "TCP"),
+    PortCase(81, "serve-81-sctp", "SCTP"),
+]
+
+
+class TestSimpleExampleParity:
+    def test_reference_fixture(self):
+        pols = load_policies_from_path(
+            "/root/reference/networkpolicies/simple-example"
+        )
+        policy = build_network_policies(True, pols)
+        pods, namespaces = default_cluster()
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_reference_fixture_sharded(self):
+        pols = load_policies_from_path(
+            "/root/reference/networkpolicies/simple-example"
+        )
+        policy = build_network_policies(True, pols)
+        pods, namespaces = default_cluster()
+        assert_parity(policy, pods, namespaces, CASES_MULTI, sharded=True)
+
+
+def mkpol(name, ns, pod_sel, types, ingress=None, egress=None):
+    return NetworkPolicy(
+        name=name,
+        namespace=ns,
+        spec=NetworkPolicySpec(
+            pod_selector=pod_sel,
+            policy_types=types,
+            ingress=ingress or [],
+            egress=egress or [],
+        ),
+    )
+
+
+class TestHandwrittenParity:
+    def test_empty_policy_set(self):
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(True, [])
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_deny_all(self):
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [mkpol("deny", "x", LabelSelector.make(), ["Ingress", "Egress"])],
+        )
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_match_expressions_all_operators(self):
+        pods, namespaces = default_cluster()
+        sel = LabelSelector.make(
+            match_expressions=[
+                LabelSelectorRequirement("pod", OP_IN, ("a", "b")),
+            ]
+        )
+        peer_sel = LabelSelector.make(
+            match_expressions=[
+                LabelSelectorRequirement("pod", OP_NOT_IN, ("c",)),
+            ]
+        )
+        ns_sel = LabelSelector.make(
+            match_expressions=[LabelSelectorRequirement("ns", OP_EXISTS)]
+        )
+        missing_sel = LabelSelector.make(
+            match_expressions=[
+                LabelSelectorRequirement("missing", OP_DOES_NOT_EXIST)
+            ]
+        )
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "p1",
+                    "x",
+                    sel,
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            from_=[
+                                NetworkPolicyPeer(
+                                    pod_selector=peer_sel,
+                                    namespace_selector=ns_sel,
+                                )
+                            ]
+                        )
+                    ],
+                ),
+                mkpol(
+                    "p2",
+                    "y",
+                    missing_sel,
+                    ["Egress"],
+                    egress=[
+                        NetworkPolicyEgressRule(
+                            to=[NetworkPolicyPeer(pod_selector=missing_sel)]
+                        )
+                    ],
+                ),
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_named_ports_and_ranges(self):
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "named",
+                    "x",
+                    LabelSelector.make(match_labels={"pod": "a"}),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            ports=[
+                                NetworkPolicyPort(
+                                    protocol="TCP", port=IntOrString("serve-80-tcp")
+                                ),
+                                NetworkPolicyPort(
+                                    protocol="SCTP",
+                                    port=IntOrString(79),
+                                    end_port=81,
+                                ),
+                            ]
+                        )
+                    ],
+                ),
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_wrong_protocol_named_port(self):
+        # rule: named port on UDP; traffic: same name on TCP => no match
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "named-udp",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            ports=[
+                                NetworkPolicyPort(
+                                    protocol="UDP", port=IntOrString("serve-80-tcp")
+                                )
+                            ]
+                        )
+                    ],
+                )
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_ipblock_with_excepts(self):
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "ip",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress", "Egress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            from_=[
+                                NetworkPolicyPeer(
+                                    ip_block=IPBlock.make(
+                                        "192.168.1.0/28", ["192.168.1.4/30"]
+                                    )
+                                )
+                            ]
+                        )
+                    ],
+                    egress=[
+                        NetworkPolicyEgressRule(
+                            to=[
+                                NetworkPolicyPeer(
+                                    ip_block=IPBlock.make("192.168.1.0/24")
+                                )
+                            ],
+                            ports=[
+                                NetworkPolicyPort(
+                                    protocol="TCP", port=IntOrString(80)
+                                )
+                            ],
+                        )
+                    ],
+                )
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_ipv6_ipblock_host_fallback(self):
+        namespaces = {"x": {"ns": "x"}}
+        pods = [
+            ("x", "a", {"pod": "a"}, "2001:db8::1"),
+            ("x", "b", {"pod": "b"}, "192.168.1.2"),
+        ]
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "ip6",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            from_=[
+                                NetworkPolicyPeer(
+                                    ip_block=IPBlock.make("2001:db8::/32")
+                                )
+                            ]
+                        )
+                    ],
+                )
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_namespace_selector_distinct_labels(self):
+        # Regression: ns vocab ids are assigned during direction encoding
+        # (targets first), so the ns-label row table must be indexed by vocab
+        # id, not dict order.
+        namespaces = {"x": {"team": "red"}, "y": {"team": "blue"}}
+        pods = [
+            ("x", "a", {"pod": "a"}, "10.0.0.1"),
+            ("y", "b", {"pod": "b"}, "10.0.0.2"),
+        ]
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "from-red",
+                    "y",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            from_=[
+                                NetworkPolicyPeer(
+                                    namespace_selector=LabelSelector.make(
+                                        match_labels={"team": "red"}
+                                    )
+                                )
+                            ]
+                        )
+                    ],
+                )
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_pod_in_unknown_namespace(self):
+        # A pod whose namespace has no entry in the namespaces dict gets
+        # empty namespace labels (oracle: namespaces.get(ns, {})).
+        namespaces = {"x": {"team": "red"}}
+        pods = [
+            ("x", "a", {"pod": "a"}, "10.0.0.1"),
+            ("ghost", "g", {"pod": "g"}, "10.0.0.2"),
+        ]
+        sel_absent = LabelSelector.make(
+            match_expressions=[
+                LabelSelectorRequirement("team", OP_DOES_NOT_EXIST)
+            ]
+        )
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "from-teamless-ns",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            from_=[
+                                NetworkPolicyPeer(namespace_selector=sel_absent)
+                            ]
+                        )
+                    ],
+                ),
+                mkpol("deny-ghost", "ghost", LabelSelector.make(), ["Ingress"]),
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_v4_mapped_pod_ip(self):
+        # ::ffff:10.0.0.5 must match an IPv4 CIDR like Go's To4 handling.
+        namespaces = {"x": {"ns": "x"}}
+        pods = [
+            ("x", "a", {"pod": "a"}, "::ffff:10.0.0.5"),
+            ("x", "b", {"pod": "b"}, "10.0.0.9"),
+        ]
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "ip4",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            from_=[
+                                NetworkPolicyPeer(
+                                    ip_block=IPBlock.make("10.0.0.0/29")
+                                )
+                            ]
+                        )
+                    ],
+                )
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_unknown_protocol_strings(self):
+        # Equal unknown protocol strings must match (oracle compares
+        # strings); distinct ones must not.
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "weird",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            ports=[
+                                NetworkPolicyPort(
+                                    protocol="FOO", port=IntOrString(80)
+                                )
+                            ]
+                        )
+                    ],
+                )
+            ],
+        )
+        cases = [
+            PortCase(80, "", "FOO"),  # equal unknown: match
+            PortCase(80, "", "BAR"),  # different unknown: no match
+            PortCase(80, "", "TCP"),
+        ]
+        assert_parity(policy, pods, namespaces, cases)
+
+    def test_ports_for_all_peers(self):
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "allports",
+                    "y",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            ports=[
+                                NetworkPolicyPort(
+                                    protocol="UDP", port=IntOrString(80)
+                                )
+                            ]
+                        )
+                    ],
+                )
+            ],
+        )
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+
+def random_selector(rng, keys, values):
+    kind = rng.randrange(4)
+    if kind == 0:
+        return LabelSelector.make()
+    if kind == 1:
+        n = rng.randrange(1, 3)
+        return LabelSelector.make(
+            match_labels={rng.choice(keys): rng.choice(values) for _ in range(n)}
+        )
+    exprs = []
+    for _ in range(rng.randrange(1, 3)):
+        op = rng.choice([OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST])
+        vals = (
+            tuple(rng.choice(values) for _ in range(rng.randrange(1, 3)))
+            if op in (OP_IN, OP_NOT_IN)
+            else ()
+        )
+        exprs.append(LabelSelectorRequirement(rng.choice(keys), op, vals))
+    ml = (
+        {rng.choice(keys): rng.choice(values)} if kind == 3 else {}
+    )
+    return LabelSelector.make(match_labels=ml, match_expressions=exprs)
+
+
+def random_peer(rng, keys, values):
+    kind = rng.randrange(5)
+    if kind == 0:
+        base = f"192.168.{rng.randrange(4)}.0"
+        prefix = rng.choice([16, 24, 28, 30])
+        excepts = (
+            [f"192.168.{rng.randrange(4)}.{rng.randrange(0, 255, 4)}/30"]
+            if rng.random() < 0.5
+            else []
+        )
+        return NetworkPolicyPeer(ip_block=IPBlock.make(f"{base}/{prefix}", excepts))
+    pod_sel = random_selector(rng, keys, values) if rng.random() < 0.8 else None
+    ns_sel = random_selector(rng, keys, values) if rng.random() < 0.6 else None
+    if pod_sel is None and ns_sel is None:
+        pod_sel = LabelSelector.make()
+    return NetworkPolicyPeer(pod_selector=pod_sel, namespace_selector=ns_sel)
+
+
+def random_ports(rng):
+    if rng.random() < 0.3:
+        return []
+    ports = []
+    for _ in range(rng.randrange(1, 3)):
+        proto = rng.choice(["TCP", "UDP", "SCTP", None])
+        r = rng.random()
+        if r < 0.2:
+            ports.append(NetworkPolicyPort(protocol=proto))
+        elif r < 0.5:
+            ports.append(
+                NetworkPolicyPort(
+                    protocol=proto, port=IntOrString(rng.choice([79, 80, 81, 82]))
+                )
+            )
+        elif r < 0.75:
+            ports.append(
+                NetworkPolicyPort(
+                    protocol=proto,
+                    port=IntOrString(
+                        rng.choice(["serve-80-tcp", "serve-81-udp", "nope"])
+                    ),
+                )
+            )
+        else:
+            lo = rng.choice([78, 80])
+            ports.append(
+                NetworkPolicyPort(
+                    protocol=proto,
+                    port=IntOrString(lo),
+                    end_port=lo + rng.randrange(0, 4),
+                )
+            )
+    return ports
+
+
+def random_policy(rng, idx, nss, keys, values):
+    types = rng.choice([["Ingress"], ["Egress"], ["Ingress", "Egress"]])
+    ingress, egress = [], []
+    if "Ingress" in types:
+        for _ in range(rng.randrange(0, 3)):
+            peers = [
+                random_peer(rng, keys, values) for _ in range(rng.randrange(0, 3))
+            ]
+            ingress.append(
+                NetworkPolicyIngressRule(ports=random_ports(rng), from_=peers)
+            )
+    if "Egress" in types:
+        for _ in range(rng.randrange(0, 3)):
+            peers = [
+                random_peer(rng, keys, values) for _ in range(rng.randrange(0, 3))
+            ]
+            egress.append(NetworkPolicyEgressRule(ports=random_ports(rng), to=peers))
+    return mkpol(
+        f"rand-{idx}",
+        rng.choice(nss),
+        random_selector(rng, keys, values),
+        types,
+        ingress=ingress,
+        egress=egress,
+    )
+
+
+class TestFuzzParity:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_fuzz(self, seed):
+        rng = random.Random(seed)
+        nss = ["x", "y", "z"]
+        # key/value pools overlap with the namespace labels below, so random
+        # selectors genuinely discriminate between namespaces (a blind spot a
+        # review round found: ns-row misindexing was invisible to an earlier
+        # fuzzer whose selectors matched all-or-no namespaces)
+        keys = ["pod", "app", "tier", "ns", "team"]
+        values = ["a", "b", "c", "web", "db", "x", "y", "z", "blue", "red"]
+        namespaces = {
+            ns: {"ns": ns, "team": rng.choice(["blue", "red"])} for ns in nss
+        }
+        pods = []
+        ip = 1
+        for ns in nss:
+            for pname in ("a", "b", "c"):
+                labels = {"pod": pname}
+                if rng.random() < 0.5:
+                    labels[rng.choice(keys)] = rng.choice(values)
+                pods.append((ns, pname, labels, f"192.168.{rng.randrange(2)}.{ip}"))
+                ip += 1
+        policies = [
+            random_policy(rng, i, nss, keys, values)
+            for i in range(rng.randrange(1, 6))
+        ]
+        policy = build_network_policies(True, policies)
+        cases = [
+            PortCase(80, "serve-80-tcp", "TCP"),
+            PortCase(81, "serve-81-udp", "UDP"),
+            PortCase(79, "", "SCTP"),
+        ]
+        assert_parity(policy, pods, namespaces, cases)
+
+    @pytest.mark.parametrize("seed", [0, 5, 9])
+    def test_fuzz_sharded_matches_oracle(self, seed):
+        rng = random.Random(seed + 1000)
+        nss = ["x", "y"]
+        keys = ["pod", "app"]
+        values = ["a", "b", "c"]
+        namespaces = {ns: {"ns": ns} for ns in nss}
+        pods = [
+            (ns, f"p{i}", {"pod": rng.choice(values)}, f"10.0.{j}.{i + 1}")
+            for j, ns in enumerate(nss)
+            for i in range(5)
+        ]
+        policies = [
+            random_policy(rng, i, nss, keys, values) for i in range(4)
+        ]
+        policy = build_network_policies(True, policies)
+        cases = [PortCase(80, "serve-80-tcp", "TCP"), PortCase(81, "", "UDP")]
+        assert_parity(policy, pods, namespaces, cases, sharded=True)
