@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Benchmark: simulated connectivity cells/sec on a synthetic service-mesh
+cluster (BASELINE.md config 3 by default: 10k pods x 1k policies, dense
+label matching).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "cells/sec", "vs_baseline": N}
+
+vs_baseline is measured against the north-star rate from BASELINE.json
+(100k-pod x 10k-policy full matrix in <10s => 1e9 cells/sec).
+
+The reference publishes no numbers (BASELINE.md); its simulated engine is a
+sequential Go loop (jobrunner.go:68-74).  A scalar-oracle spot check on a
+random sample of cells guards against benchmarking a wrong kernel.
+
+Env overrides: BENCH_PODS, BENCH_POLICIES, BENCH_SHARDED=1 (mesh over all
+visible devices), BENCH_SAMPLE (oracle spot-check size).
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+BASELINE_CELLS_PER_SEC = 1e9
+
+
+def build_synthetic(n_pods: int, n_policies: int, rng: random.Random):
+    from cyclonus_tpu.kube.netpol import (
+        IntOrString,
+        LabelSelector,
+        NetworkPolicy,
+        NetworkPolicyEgressRule,
+        NetworkPolicyIngressRule,
+        NetworkPolicyPeer,
+        NetworkPolicyPort,
+        NetworkPolicySpec,
+        IPBlock,
+    )
+
+    n_ns = max(2, n_pods // 250)
+    namespaces = {
+        f"ns{i}": {"ns": f"ns{i}", "team": f"team{i % 7}"} for i in range(n_ns)
+    }
+    pods = []
+    for i in range(n_pods):
+        ns = f"ns{i % n_ns}"
+        labels = {
+            "pod": f"p{i % 100}",
+            "app": f"app{i % 20}",
+            "tier": f"tier{i % 5}",
+        }
+        ip = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
+        pods.append((ns, f"pod-{i}", labels, ip))
+
+    policies = []
+    for i in range(n_policies):
+        ns = f"ns{rng.randrange(n_ns)}"
+        target = LabelSelector.make(match_labels={"app": f"app{rng.randrange(20)}"})
+        peers = []
+        r = rng.random()
+        if r < 0.2:
+            peers.append(
+                NetworkPolicyPeer(
+                    ip_block=IPBlock.make(
+                        f"10.{rng.randrange(4)}.0.0/16",
+                        [f"10.{rng.randrange(4)}.{rng.randrange(8)}.0/24"],
+                    )
+                )
+            )
+        else:
+            peers.append(
+                NetworkPolicyPeer(
+                    pod_selector=LabelSelector.make(
+                        match_labels={"tier": f"tier{rng.randrange(5)}"}
+                    ),
+                    namespace_selector=LabelSelector.make(
+                        match_labels={"team": f"team{rng.randrange(7)}"}
+                    )
+                    if rng.random() < 0.5
+                    else None,
+                )
+            )
+        ports = [NetworkPolicyPort(protocol="TCP", port=IntOrString(80))]
+        if rng.random() < 0.3:
+            ports.append(
+                NetworkPolicyPort(
+                    protocol="UDP", port=IntOrString("serve-81-udp")
+                )
+            )
+        rule_i = NetworkPolicyIngressRule(ports=ports, from_=peers)
+        rule_e = NetworkPolicyEgressRule(ports=ports, to=peers)
+        types = ["Ingress"] if rng.random() < 0.6 else ["Ingress", "Egress"]
+        policies.append(
+            NetworkPolicy(
+                name=f"bench-{i}",
+                namespace=ns,
+                spec=NetworkPolicySpec(
+                    pod_selector=target,
+                    policy_types=types,
+                    ingress=[rule_i],
+                    egress=[rule_e] if "Egress" in types else [],
+                ),
+            )
+        )
+    return pods, namespaces, policies
+
+
+def spot_check(policy, pods, namespaces, cases, grid, n_samples, rng):
+    from cyclonus_tpu.matcher import InternalPeer, Traffic, TrafficPeer
+
+    n = len(pods)
+    triples = [
+        (rng.randrange(len(cases)), rng.randrange(n), rng.randrange(n))
+        for _ in range(n_samples)
+    ]
+    got = grid.gather(triples)  # one device gather, one tiny transfer
+    for (qi, si, di), got_row in zip(triples, got):
+        case = cases[qi]
+        sns, _, slabels, sip = pods[si]
+        dns, _, dlabels, dip = pods[di]
+        t = Traffic(
+            source=TrafficPeer(
+                internal=InternalPeer(slabels, namespaces.get(sns, {}), sns), ip=sip
+            ),
+            destination=TrafficPeer(
+                internal=InternalPeer(dlabels, namespaces.get(dns, {}), dns), ip=dip
+            ),
+            resolved_port=case.port,
+            resolved_port_name=case.port_name,
+            protocol=case.protocol,
+        )
+        r = policy.is_traffic_allowed(t)
+        expected = (r.ingress.is_allowed, r.egress.is_allowed, r.is_allowed)
+        if tuple(bool(x) for x in got_row) != expected:
+            raise AssertionError(
+                f"PARITY FAILURE at q={case} s={si} d={di}: "
+                f"oracle={expected} engine={tuple(got_row)}"
+            )
+
+
+def main():
+    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+    n_policies = int(os.environ.get("BENCH_POLICIES", "1000"))
+    sharded = os.environ.get("BENCH_SHARDED", "") == "1"
+    n_samples = int(os.environ.get("BENCH_SAMPLE", "150"))
+    rng = random.Random(20260729)
+
+    from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
+    from cyclonus_tpu.matcher import build_network_policies
+
+    pods, namespaces, policies = build_synthetic(n_pods, n_policies, rng)
+    t0 = time.time()
+    policy = build_network_policies(True, policies)
+    t_build = time.time() - t0
+
+    t0 = time.time()
+    engine = TpuPolicyEngine(policy, pods, namespaces)
+    t_encode = time.time() - t0
+
+    cases = [PortCase(80, "serve-80-tcp", "TCP"), PortCase(81, "serve-81-udp", "UDP")]
+
+    def run():
+        if sharded:
+            g = engine.evaluate_grid_sharded(cases)
+        else:
+            g = engine.evaluate_grid(cases)
+        # a scalar readback is the only reliable execution barrier over a
+        # tunneled device (block_until_ready can return optimistically)
+        g.allow_stats()
+        return g
+
+    # warmup (jit compile)
+    t0 = time.time()
+    grid = run()
+    t_warm = time.time() - t0
+
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        grid = run()
+        times.append(time.time() - t0)
+    t_eval = min(times)
+
+    cells = len(cases) * n_pods * n_pods
+    cells_per_sec = cells / t_eval
+
+    spot_check(policy, pods, namespaces, cases, grid, n_samples, rng)
+
+    allow_rate = grid.allow_stats()["combined"]
+    print(
+        json.dumps(
+            {
+                "metric": f"simulated connectivity cells/sec ({n_pods} pods x "
+                f"{n_policies} policies, {len(cases)} port cases, "
+                f"{'sharded' if sharded else 'single-device'})",
+                "value": round(cells_per_sec),
+                "unit": "cells/sec",
+                "vs_baseline": round(cells_per_sec / BASELINE_CELLS_PER_SEC, 4),
+                "detail": {
+                    "build_s": round(t_build, 3),
+                    "encode_s": round(t_encode, 3),
+                    "warmup_s": round(t_warm, 3),
+                    "eval_s": round(t_eval, 4),
+                    "allow_rate": round(allow_rate, 4),
+                    "parity_spot_checks": n_samples,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

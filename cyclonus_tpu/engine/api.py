@@ -28,15 +28,44 @@ class PortCase:
     protocol: str
 
 
-@dataclass
 class GridVerdict:
-    """Boolean verdict grids, numpy, indexed by the engine's pod order."""
+    """Verdict grids.  The underlying arrays stay DEVICE-RESIDENT (host
+    transfer of an N x N x Q grid dominates wall-clock at scale, especially
+    over a tunneled TPU); numpy views materialize lazily on first access,
+    and `gather` fetches individual cells with one device-side take."""
 
-    pod_keys: List[str]
-    port_cases: List[PortCase]
-    ingress: np.ndarray  # [Q, N_dst, N_src]
-    egress: np.ndarray  # [Q, N_src, N_dst]
-    combined: np.ndarray  # [Q, N_src, N_dst]
+    def __init__(self, pod_keys, port_cases, ingress_dev, egress_dev, combined_dev):
+        self.pod_keys: List[str] = pod_keys
+        self.port_cases: List[PortCase] = port_cases
+        # device arrays: ingress [Q, N_dst, N_src]; egress/combined
+        # [Q, N_src, N_dst]
+        self.ingress_dev = ingress_dev
+        self.egress_dev = egress_dev
+        self.combined_dev = combined_dev
+        self._np: Dict[str, np.ndarray] = {}
+
+    def block_until_ready(self) -> "GridVerdict":
+        for a in (self.ingress_dev, self.egress_dev, self.combined_dev):
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+        return self
+
+    def _materialize(self, name: str) -> np.ndarray:
+        if name not in self._np:
+            self._np[name] = np.asarray(getattr(self, name + "_dev"))
+        return self._np[name]
+
+    @property
+    def ingress(self) -> np.ndarray:
+        return self._materialize("ingress")
+
+    @property
+    def egress(self) -> np.ndarray:
+        return self._materialize("egress")
+
+    @property
+    def combined(self) -> np.ndarray:
+        return self._materialize("combined")
 
     def job_verdict(self, q_idx: int, src_idx: int, dst_idx: int):
         return (
@@ -44,6 +73,36 @@ class GridVerdict:
             bool(self.egress[q_idx, src_idx, dst_idx]),
             bool(self.combined[q_idx, src_idx, dst_idx]),
         )
+
+    def gather(self, triples: Sequence[Tuple[int, int, int]]) -> np.ndarray:
+        """Fetch (ingress, egress, combined) for a batch of (q, src, dst)
+        triples with one device gather + one tiny transfer — no full-grid
+        materialization."""
+        import jax.numpy as jnp
+
+        idx = np.array(triples, dtype=np.int32).reshape(-1, 3)
+        if idx.shape[0] == 0:
+            return np.zeros((0, 3), dtype=bool)
+        q, s, d = idx[:, 0], idx[:, 1], idx[:, 2]
+        out = jnp.stack(
+            [
+                self.ingress_dev[q, d, s],
+                self.egress_dev[q, s, d],
+                self.combined_dev[q, s, d],
+            ],
+            axis=1,
+        )
+        return np.asarray(out)
+
+    def allow_stats(self) -> Dict[str, float]:
+        """Device-side aggregate: mean allow rate per grid."""
+        import jax.numpy as jnp
+
+        return {
+            "ingress": float(jnp.mean(self.ingress_dev)),
+            "egress": float(jnp.mean(self.egress_dev)),
+            "combined": float(jnp.mean(self.combined_dev)),
+        }
 
 
 def _direction_tensors(enc: _DirectionEncoding) -> Dict:
@@ -83,6 +142,7 @@ class TpuPolicyEngine:
     ):
         self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
         self._tensors = self._build_tensors()
+        self._device_tensors = None  # lazily device_put once
         self._has_ip_peers = (
             bool(np.any(self.encoding.ingress.peer_kind == PEER_IP))
             or bool(np.any(self.encoding.egress.peer_kind == PEER_IP))
@@ -159,7 +219,11 @@ class TpuPolicyEngine:
             )
 
     def evaluate_grid(self, cases: Sequence[PortCase]) -> GridVerdict:
-        """Single-device evaluation of the full N x N x Q verdict grid."""
+        """Single-device evaluation of the full N x N x Q verdict grid.
+        Results stay on device (see GridVerdict)."""
+        import jax
+        import jax.numpy as jnp
+
         from .kernel import evaluate_grid_kernel
 
         self._check_ips()
@@ -168,22 +232,30 @@ class TpuPolicyEngine:
             empty = np.zeros((0, n, n), dtype=bool)
             return GridVerdict(self.pod_keys, [], empty, empty.copy(), empty.copy())
         q_port, q_name, q_proto = self._port_case_arrays(cases)
-        tensors = dict(self._tensors)
+        if self._device_tensors is None:
+            self._device_tensors = jax.device_put(self._tensors)
+        tensors = dict(self._device_tensors)
         tensors["q_port"] = q_port
         tensors["q_name"] = q_name
         tensors["q_proto"] = q_proto
         out = evaluate_grid_kernel(tensors)
-        # kernel layout: [target-side, peer-side, q] -> [q, ...]
-        ingress = np.moveaxis(np.asarray(out["ingress"]), -1, 0)
-        egress = np.moveaxis(np.asarray(out["egress"]), -1, 0)
-        combined = np.moveaxis(np.asarray(out["combined"]), -1, 0)
-        return GridVerdict(self.pod_keys, list(cases), ingress, egress, combined)
+        # kernel layout: [target-side, peer-side, q] -> [q, ...] on device
+        return GridVerdict(
+            self.pod_keys,
+            list(cases),
+            jnp.moveaxis(out["ingress"], -1, 0),
+            jnp.moveaxis(out["egress"], -1, 0),
+            jnp.moveaxis(out["combined"], -1, 0),
+        )
 
     def evaluate_grid_sharded(
         self, cases: Sequence[PortCase], mesh=None
     ) -> GridVerdict:
-        """Mesh-sharded evaluation (source axis over devices); falls back to
-        the single-device kernel when only one device is available."""
+        """Mesh-sharded evaluation: the shard_map program runs over `mesh`
+        (default: all devices of the default backend, or the virtual CPU
+        mesh when the default backend is a single chip — see
+        sharded.default_mesh).  A 1-device mesh still runs the sharded
+        program; use evaluate_grid for the plain single-device kernel."""
         from .sharded import evaluate_grid_sharded
 
         self._check_ips()
@@ -194,15 +266,17 @@ class TpuPolicyEngine:
         tensors["q_port"] = q_port
         tensors["q_name"] = q_name
         tensors["q_proto"] = q_proto
+        import jax.numpy as jnp
+
         ingress, egress, combined = evaluate_grid_sharded(
             tensors, self.encoding.cluster.n_pods, mesh=mesh
         )
         return GridVerdict(
             self.pod_keys,
             list(cases),
-            np.moveaxis(ingress, -1, 0),
-            np.moveaxis(egress, -1, 0),
-            np.moveaxis(combined, -1, 0),
+            jnp.moveaxis(ingress, -1, 0),
+            jnp.moveaxis(egress, -1, 0),
+            jnp.moveaxis(combined, -1, 0),
         )
 
 

@@ -38,7 +38,19 @@ _POD_KEYS = ("pod_ns_id", "pod_kv", "pod_key", "pod_ip", "pod_ip_valid")
 
 
 def default_mesh() -> Mesh:
-    return Mesh(np.array(jax.devices()), ("x",))
+    """All devices of the default backend; when that's a single chip (e.g. a
+    tunneled TPU) but the CPU backend exposes a virtual multi-device mesh
+    (xla_force_host_platform_device_count), prefer the latter so the
+    collective paths actually run multi-device."""
+    devices = jax.devices()
+    if len(devices) == 1:
+        try:
+            cpu_devices = jax.devices("cpu")
+        except RuntimeError:
+            cpu_devices = devices
+        if len(cpu_devices) > 1:
+            devices = cpu_devices
+    return Mesh(np.array(devices), ("x",))
 
 
 def _pad_pod_arrays(tensors: Dict, n_pods: int, n_dev: int) -> Tuple[Dict, int]:
@@ -169,7 +181,8 @@ def evaluate_grid_sharded(
     tensors: Dict, n_pods: int, mesh: Optional[Mesh] = None
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Returns (ingress[N_dst, N_src, Q], egress[N_src, N_dst, Q],
-    combined[N_src, N_dst, Q]) as numpy, pad rows stripped."""
+    combined[N_src, N_dst, Q]) as DEVICE-RESIDENT (immutable) jax arrays,
+    pad rows stripped lazily."""
     mesh = mesh or default_mesh()
     n_dev = mesh.devices.size
     tensors, _padded_n = _pad_pod_arrays(tensors, n_pods, n_dev)
@@ -215,9 +228,10 @@ def evaluate_grid_sharded(
         )
     )
     ingress_rows, egress, combined = fn(tensors)
-    ingress_rows = np.asarray(ingress_rows)[:n_pods, :n_pods]
-    egress = np.asarray(egress)[:n_pods, :n_pods]
-    combined = np.asarray(combined)[:n_pods, :n_pods]
-    # ingress_rows is [src, dst, q]; API layout is [dst, src, q]
-    ingress = np.swapaxes(ingress_rows, 0, 1)
+    # stay on device: strip pad rows and fix the ingress layout
+    # ([src, dst, q] -> [dst, src, q]) with lazy jnp ops
+    ingress_rows = ingress_rows[:n_pods, :n_pods]
+    egress = egress[:n_pods, :n_pods]
+    combined = combined[:n_pods, :n_pods]
+    ingress = jnp.swapaxes(ingress_rows, 0, 1)
     return ingress, egress, combined

@@ -272,6 +272,9 @@ class MockKubernetes(IKubernetes):
         obj.labels = dict(labels)
         return obj
 
+    def get_pods_in_namespace(self, namespace: str) -> List[KubePod]:
+        return list(self._ns(namespace).pods.values())
+
     # exec
 
     def execute_remote_command(

@@ -19,6 +19,7 @@ class KubeContainerPort:
 class KubeContainer:
     name: str
     ports: List[KubeContainerPort] = field(default_factory=list)
+    image: str = ""
 
 
 @dataclass

@@ -1,0 +1,10 @@
+"""In-pod batch prober (reference: pkg/worker): avoids an apiserver exec
+storm by issuing ONE kubectl-exec per source pod carrying a JSON batch of
+probe requests; the in-pod worker fans out with a thread pool and returns
+JSON results on stdout."""
+
+from .model import Batch, Request, Result
+from .client import Client
+from .worker import run_worker, issue_batch
+
+__all__ = ["Batch", "Request", "Result", "Client", "run_worker", "issue_batch"]

@@ -1,0 +1,29 @@
+"""Driver-side worker client (reference: worker/client.go): marshal a
+batch, kubectl-exec the in-pod worker, parse its stdout."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ..kube.ikubernetes import IKubernetes, KubeError
+from .model import Batch, Result
+
+
+class Client:
+    def __init__(self, kubernetes: IKubernetes):
+        self.kubernetes = kubernetes
+
+    def batch(self, batch: Batch) -> List[Result]:
+        """client.go:14-41."""
+        command = ["/worker", "--jobs", batch.to_json()]
+        stdout, _stderr, command_err = self.kubernetes.execute_remote_command(
+            batch.namespace, batch.pod, batch.container, command
+        )
+        if command_err is not None:
+            raise KubeError(f"worker exec failed: {command_err}")
+        try:
+            parsed = json.loads(stdout) if stdout.strip() else []
+        except json.JSONDecodeError as e:
+            raise KubeError(f"unable to parse worker output: {e}")
+        return [Result.from_dict(d) for d in parsed]

@@ -1,0 +1,104 @@
+"""Batch/Request/Result wire model (reference: worker/model.go)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Request:
+    """model.go:26-48."""
+
+    key: str
+    protocol: str
+    host: str
+    port: int
+
+    def command(self) -> List[str]:
+        """The agnhost connect invocation (model.go:50-61)."""
+        proto = self.protocol.lower()
+        if proto not in ("tcp", "udp", "sctp"):
+            raise ValueError(f"invalid protocol {self.protocol}")
+        return [
+            "/agnhost",
+            "connect",
+            f"{self.host}:{self.port}",
+            "--timeout=1s",
+            f"--protocol={proto}",
+        ]
+
+    def to_dict(self) -> dict:
+        return {
+            "Key": self.key,
+            "Protocol": self.protocol,
+            "Host": self.host,
+            "Port": self.port,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Request":
+        return Request(
+            key=d["Key"], protocol=d["Protocol"], host=d["Host"], port=d["Port"]
+        )
+
+
+@dataclass
+class Batch:
+    """model.go:9-24."""
+
+    namespace: str
+    pod: str
+    container: str
+    requests: List[Request] = field(default_factory=list)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.pod}/{self.container}"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "Namespace": self.namespace,
+                "Pod": self.pod,
+                "Container": self.container,
+                "Requests": [r.to_dict() for r in self.requests],
+            }
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "Batch":
+        d = json.loads(text)
+        return Batch(
+            namespace=d.get("Namespace", ""),
+            pod=d.get("Pod", ""),
+            container=d.get("Container", ""),
+            requests=[Request.from_dict(r) for r in d.get("Requests") or []],
+        )
+
+
+@dataclass
+class Result:
+    """model.go:50-61."""
+
+    request: Request
+    output: str = ""
+    error: str = ""
+
+    def is_success(self) -> bool:
+        return self.error == ""
+
+    def to_dict(self) -> dict:
+        return {
+            "Request": self.request.to_dict(),
+            "Output": self.output,
+            "Error": self.error,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Result":
+        return Result(
+            request=Request.from_dict(d["Request"]),
+            output=d.get("Output", ""),
+            error=d.get("Error", ""),
+        )

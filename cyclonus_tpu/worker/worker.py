@@ -1,0 +1,50 @@
+"""The in-pod worker side (reference: worker/worker.go): parse a JSON
+batch, issue the probes concurrently, print JSON results."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+from .model import Batch, Request, Result
+
+DEFAULT_CONCURRENCY = 10
+RETRIES = 1
+
+
+def _issue_one(request: Request) -> Result:
+    """worker.go:60-84 with one retry (worker.go:62-68)."""
+    command = request.command()
+    last_err = ""
+    out = ""
+    for _attempt in range(1 + RETRIES):
+        try:
+            proc = subprocess.run(
+                command, capture_output=True, text=True, timeout=5
+            )
+            out = proc.stdout
+            if proc.returncode == 0:
+                return Result(request=request, output=out)
+            last_err = proc.stderr.strip() or f"exit code {proc.returncode}"
+        except FileNotFoundError as e:
+            last_err = str(e)
+        except subprocess.TimeoutExpired:
+            last_err = "timeout"
+    return Result(request=request, output=out, error=last_err)
+
+
+def issue_batch(batch: Batch, concurrency: int = DEFAULT_CONCURRENCY) -> List[Result]:
+    """worker.go:38-58."""
+    if not batch.requests:
+        return []
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        return list(pool.map(_issue_one, batch.requests))
+
+
+def run_worker(jobs_json: str) -> str:
+    """worker.go:18-36: JSON in, JSON out."""
+    batch = Batch.from_json(jobs_json)
+    results = issue_batch(batch)
+    return json.dumps([r.to_dict() for r in results])
