@@ -1,0 +1,7 @@
+"""CLI (reference: pkg/cli): analyze / generate / probe / version.
+
+Run as `python -m cyclonus_tpu <command> ...`."""
+
+from .root import main
+
+__all__ = ["main"]

@@ -1,0 +1,223 @@
+"""`analyze` command: parse / explain / lint / query-target /
+query-traffic / probe modes (reference: pkg/cli/analyze.go)."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ..kube.labels import label_selector_table_lines
+from ..kube.netpol import IntOrString, LabelSelector, NetworkPolicy
+from ..kube.yaml_io import load_policies_from_path
+from ..matcher.builder import build_network_policies
+from ..matcher.core import Policy, Traffic, combine_targets_ignoring_primary_key
+from ..matcher.explain import explain_table
+from ..utils.table import render_table
+
+ALL_MODES = ["parse", "explain", "lint", "query-target", "query-traffic", "probe"]
+
+
+def setup_analyze(sub) -> None:
+    cmd = sub.add_parser("analyze", help="analyze network policies")
+    cmd.add_argument(
+        "--mode",
+        action="append",
+        default=None,
+        choices=ALL_MODES,
+        help="analysis modes to run (default: explain)",
+    )
+    cmd.add_argument(
+        "--policy-path",
+        default="",
+        help="file or directory to read policies from",
+    )
+    cmd.add_argument(
+        "--use-example-policies",
+        action="store_true",
+        help="if true, reads example policies",
+    )
+    cmd.add_argument(
+        "-n",
+        "--namespace",
+        action="append",
+        default=[],
+        help="namespaces to read policies from a live cluster (via kubectl)",
+    )
+    cmd.add_argument("--context", default="", help="kube context")
+    cmd.add_argument(
+        "--simplify-policies",
+        default=True,
+        action=_bool_action(),
+        help="reduce policies to simpler form while preserving semantics",
+    )
+    cmd.add_argument("--target-pod-path", default="", help="json target pod file")
+    cmd.add_argument("--traffic-path", default="", help="json traffic file")
+    cmd.add_argument("--probe-path", default="", help="json synthetic probe model")
+    cmd.add_argument(
+        "--engine",
+        default="tpu",
+        choices=["oracle", "tpu"],
+        help="simulated engine for probe mode",
+    )
+    cmd.set_defaults(func=run_analyze)
+
+
+def _bool_action():
+    import argparse
+
+    class _B(argparse.Action):
+        def __call__(self, parser, namespace, values, option_string=None):
+            setattr(namespace, self.dest, str(values).lower() in ("1", "true", "yes"))
+
+    return _B
+
+
+def _read_policies(args) -> List[NetworkPolicy]:
+    policies: List[NetworkPolicy] = []
+    if args.namespace:
+        from ..kube.kubectl import KubectlKubernetes
+
+        kube = KubectlKubernetes(args.context)
+        for ns in args.namespace:
+            policies.extend(kube.get_network_policies_in_namespace(ns))
+    if args.policy_path:
+        policies.extend(load_policies_from_path(args.policy_path))
+    if args.use_example_policies:
+        from ..kube.examples import all_examples
+
+        policies.extend(all_examples())
+    return policies
+
+
+def run_analyze(args) -> int:
+    modes = args.mode or ["explain"]
+    kube_policies = _read_policies(args)
+    policies = build_network_policies(args.simplify_policies, kube_policies)
+
+    for mode in modes:
+        if mode == "parse":
+            print(_parse_table(kube_policies))
+        elif mode == "explain":
+            print(explain_table(policies))
+        elif mode == "lint":
+            from ..linter import lint, warnings_table
+
+            print(warnings_table(lint(kube_policies)))
+        elif mode == "query-target":
+            _query_targets(policies, args.target_pod_path)
+        elif mode == "query-traffic":
+            _query_traffic(policies, args.traffic_path)
+        elif mode == "probe":
+            _synthetic_probe(policies, args.probe_path, args.engine)
+        else:
+            raise ValueError(f"unrecognized mode {mode}")
+    return 0
+
+
+def _parse_table(policies: List[NetworkPolicy]) -> str:
+    """kube/networkpolicy.go:11-49 equivalent summary."""
+    rows = []
+    for p in policies:
+        rows.append(
+            [
+                f"{p.effective_namespace()}/{p.name}",
+                ", ".join(p.spec.policy_types),
+                label_selector_table_lines(p.spec.pod_selector),
+                str(len(p.spec.ingress)),
+                str(len(p.spec.egress)),
+            ]
+        )
+    return render_table(
+        ["Policy", "Types", "Pod selector", "Ingress rules", "Egress rules"],
+        rows,
+        row_line=True,
+    )
+
+
+def _query_targets(policies: Policy, pod_path: str) -> None:
+    """analyze.go:170-207."""
+    if not pod_path:
+        raise ValueError("path to target pod file required for query-target")
+    with open(pod_path) as f:
+        pods = json.load(f)
+    for pod in pods:
+        namespace = pod.get("Namespace") or pod.get("namespace") or ""
+        labels = pod.get("Labels") or pod.get("labels") or {}
+        print(f"pod in ns {namespace} with labels {labels}:\n")
+        ingress_targets = policies.targets_applying_to_pod(True, namespace, labels)
+        egress_targets = policies.targets_applying_to_pod(False, namespace, labels)
+        matching = Policy.from_targets(ingress_targets, egress_targets)
+        combined_i = combine_targets_ignoring_primary_key(
+            namespace, LabelSelector.make(match_labels=labels), ingress_targets
+        )
+        combined_e = combine_targets_ignoring_primary_key(
+            namespace, LabelSelector.make(match_labels=labels), egress_targets
+        )
+        combined = Policy.from_targets(
+            [combined_i] if combined_i else [], [combined_e] if combined_e else []
+        )
+        print(f"Matching targets:\n{explain_table(matching)}")
+        print(f"Combined rules:\n{explain_table(combined)}\n\n")
+
+
+def _query_traffic(policies: Policy, traffic_path: str) -> None:
+    """analyze.go:209-225."""
+    if not traffic_path:
+        raise ValueError("path to traffic file required for query-traffic")
+    with open(traffic_path) as f:
+        traffics = json.load(f)
+    for d in traffics:
+        traffic = Traffic.from_dict(d)
+        result = policies.is_traffic_allowed(traffic)
+        print(f"Traffic: {json.dumps(d)}")
+        print(f"Is traffic allowed?\n{result.table()}\n\n")
+
+
+def _synthetic_probe(policies: Policy, probe_path: str, engine: str) -> None:
+    """analyze.go:232-299: run simulated probes over a JSON cluster model."""
+    from ..probe.pod import Container, Pod
+    from ..probe.probeconfig import ProbeConfig
+    from ..probe.resources import Resources
+    from ..probe.runner import new_simulated_runner
+
+    if not probe_path:
+        raise ValueError("path to probe model file required for probe mode")
+    with open(probe_path) as f:
+        config = json.load(f)
+
+    resources_json = config.get("Resources") or {}
+    pods = []
+    for p in resources_json.get("Pods") or []:
+        containers = [
+            Container(
+                name=c.get("Name", ""),
+                port=c["Port"],
+                protocol=c.get("Protocol", "TCP").upper(),
+                port_name=c.get("PortName", ""),
+            )
+            for c in p.get("Containers") or []
+        ]
+        pods.append(
+            Pod(
+                namespace=p["Namespace"],
+                name=p["Name"],
+                labels=p.get("Labels") or {},
+                ip=p.get("IP", ""),
+                containers=containers,
+            )
+        )
+    resources = Resources(
+        namespaces=resources_json.get("Namespaces") or {}, pods=pods
+    )
+
+    runner = new_simulated_runner(policies, engine=engine)
+    for probe_spec in config.get("Probes") or []:
+        port = IntOrString(probe_spec["Port"])
+        protocol = probe_spec.get("Protocol", "TCP")
+        table = runner.run_probe_for_config(
+            ProbeConfig.port_protocol_config(port, protocol), resources
+        )
+        print(f"probe on port {port.value}, protocol {protocol}")
+        print(f"Ingress:\n{table.render_ingress()}")
+        print(f"Egress:\n{table.render_egress()}")
+        print(f"Combined:\n{table.render_table()}\n\n")

@@ -1,0 +1,164 @@
+"""`generate` command: the flagship conformance run
+(reference: pkg/cli/generate.go)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..connectivity import Interpreter, InterpreterConfig, Printer
+from ..generator import TestCaseGenerator
+from ..generator.tags import validate_tags
+from ..kube.ikubernetes import IKubernetes, MockKubernetes
+from ..probe.resources import Resources
+
+
+def setup_generate(sub) -> None:
+    cmd = sub.add_parser(
+        "generate", help="generate and run conformance test cases against a CNI"
+    )
+    cmd.add_argument("--mock", action="store_true", help="use an in-memory mock cluster")
+    cmd.add_argument(
+        "--perfect-cni",
+        action="store_true",
+        help="with --mock: emulate a policy-correct CNI (all cases should pass)",
+    )
+    cmd.add_argument("--dry-run", action="store_true", help="print cases without running")
+    cmd.add_argument("--context", default="", help="kube context")
+    cmd.add_argument(
+        "--server-namespace", action="append", default=None, help="namespaces (default x,y,z)"
+    )
+    cmd.add_argument(
+        "--server-pod", action="append", default=None, help="pod names (default a,b,c)"
+    )
+    cmd.add_argument(
+        "--server-port", action="append", type=int, default=None, help="ports (default 80,81)"
+    )
+    cmd.add_argument(
+        "--server-protocol",
+        action="append",
+        default=None,
+        help="protocols (default TCP,UDP,SCTP)",
+    )
+    cmd.add_argument("--include", action="append", default=[], help="tags to include")
+    cmd.add_argument(
+        "--exclude",
+        action="append",
+        default=None,
+        help="tags to exclude (default: multi-peer, upstream-e2e, example)",
+    )
+    cmd.add_argument("--retries", type=int, default=1, help="kube probe retries")
+    cmd.add_argument(
+        "--perturbation-wait-seconds", type=int, default=5, help="wait after each perturbation"
+    )
+    cmd.add_argument(
+        "--pod-creation-timeout-seconds", type=int, default=60, help="pod creation timeout"
+    )
+    cmd.add_argument("--batch-jobs", action="store_true", help="use the in-pod batch worker")
+    cmd.add_argument("--ignore-loopback", action="store_true", help="ignore loopback calls")
+    cmd.add_argument("--noisy", action="store_true", help="print tables for every step")
+    cmd.add_argument(
+        "--engine", default="tpu", choices=["oracle", "tpu"], help="simulated engine"
+    )
+    cmd.add_argument(
+        "--allow-dns",
+        default=True,
+        type=lambda s: s.lower() in ("1", "true", "yes"),
+        help="inject an allow-DNS egress policy alongside egress-denying "
+        "conflict cases (default true)",
+    )
+    cmd.add_argument(
+        "--cleanup-namespaces", action="store_true", help="delete namespaces after the run"
+    )
+    cmd.add_argument(
+        "--max-cases", type=int, default=0, help="cap the number of cases (0 = all)"
+    )
+    cmd.set_defaults(func=run_generate)
+
+
+DEFAULT_EXCLUDE = ["multi-peer", "upstream-e2e", "example"]
+
+
+def run_generate(args) -> int:
+    namespaces = args.server_namespace or ["x", "y", "z"]
+    pods = args.server_pod or ["a", "b", "c"]
+    ports = args.server_port or [80, 81]
+    protocols = [p.upper() for p in (args.server_protocol or ["TCP", "UDP", "SCTP"])]
+    excluded = args.exclude if args.exclude is not None else DEFAULT_EXCLUDE
+    validate_tags(args.include)
+    validate_tags(excluded)
+
+    if args.mock:
+        kubernetes: IKubernetes = MockKubernetes(1.0)
+    else:
+        from ..kube.kubectl import KubectlKubernetes
+
+        kubernetes = KubectlKubernetes(args.context)
+
+    resources = Resources.new_default(
+        kubernetes,
+        namespaces,
+        pods,
+        ports,
+        protocols,
+        pod_creation_timeout_seconds=args.pod_creation_timeout_seconds,
+        batch_jobs=args.batch_jobs,
+    )
+    print(f"resources:\n{resources.render_table()}")
+
+    if args.mock and args.perfect_cni:
+        from ..kube.mockcni import PolicyAwareMockExec
+
+        kubernetes.exec_verdict_fn = PolicyAwareMockExec(kubernetes)
+
+    # ipblock cases derive from pod z/c's IP (generate.go:112-115)
+    zc_pod = resources.get_pod(namespaces[-1], pods[-1])
+    generator = TestCaseGenerator(
+        allow_dns=args.allow_dns,
+        pod_ip=zc_pod.ip,
+        namespaces=namespaces,
+        tags=args.include,
+        excluded_tags=excluded,
+    )
+    cases = generator.generate_test_cases()
+    if args.max_cases:
+        cases = cases[: args.max_cases]
+    print(f"test cases to run by tag:")
+    from ..generator import count_test_cases_by_tag
+
+    for tag, count in sorted(count_test_cases_by_tag(cases).items()):
+        if count:
+            print(f"  {tag}: {count}")
+    print(f"total: {len(cases)} test cases\n")
+
+    if args.dry_run:
+        for i, tc in enumerate(cases):
+            print(f"{i + 1}: {tc.description} (tags: {', '.join(tc.tags.keys_sorted())})")
+        return 0
+
+    config = InterpreterConfig(
+        reset_cluster_before_test_case=True,
+        verify_cluster_state_before_test_case=True,
+        kube_probe_retries=args.retries,
+        perturbation_wait_seconds=0 if args.mock else args.perturbation_wait_seconds,
+        batch_jobs=args.batch_jobs,
+        ignore_loopback=args.ignore_loopback,
+        simulated_engine=args.engine,
+        pod_wait_timeout_seconds=args.pod_creation_timeout_seconds,
+    )
+    interpreter = Interpreter(kubernetes, resources, config)
+    printer = Printer(noisy=args.noisy, ignore_loopback=args.ignore_loopback)
+
+    for i, tc in enumerate(cases):
+        print(f"starting test case #{i + 1} ({tc.description})")
+        result = interpreter.execute_test_case(tc)
+        printer.print_test_case_result(result)
+
+    printer.print_summary()
+
+    if args.cleanup_namespaces:
+        for ns in namespaces:
+            try:
+                kubernetes.delete_namespace(ns)
+            except Exception as e:
+                print(f"unable to delete namespace {ns}: {e}")
+    return 0

@@ -1,0 +1,119 @@
+"""`probe` command: a one-off probe against a (mock or real) cluster
+(reference: pkg/cli/probe.go)."""
+
+from __future__ import annotations
+
+from ..connectivity import Interpreter, InterpreterConfig, Printer
+from ..generator import read_network_policies, create_policy
+from ..generator.tags import StringSet
+from ..generator.testcase import TestCase, TestStep
+from ..kube.ikubernetes import IKubernetes, MockKubernetes
+from ..kube.netpol import IntOrString
+from ..kube.yaml_io import load_policies_from_path
+from ..probe.probeconfig import (
+    ALL_PROBE_MODES,
+    PROBE_MODE_SERVICE_NAME,
+    ProbeConfig,
+    ProbeMode,
+)
+from ..probe.resources import Resources
+
+
+def setup_probe(sub) -> None:
+    cmd = sub.add_parser("probe", help="run a connectivity probe against a cluster")
+    cmd.add_argument("--mock", action="store_true", help="use an in-memory mock cluster")
+    cmd.add_argument(
+        "--perfect-cni", action="store_true",
+        help="with --mock: emulate a policy-correct CNI",
+    )
+    cmd.add_argument("--context", default="", help="kube context")
+    cmd.add_argument(
+        "--server-namespace", action="append", default=None, help="namespaces (default x,y,z)"
+    )
+    cmd.add_argument(
+        "--server-pod", action="append", default=None, help="pod names (default a,b,c)"
+    )
+    cmd.add_argument(
+        "--server-port", action="append", type=int, default=None, help="ports (default 80,81)"
+    )
+    cmd.add_argument(
+        "--server-protocol", action="append", default=None,
+        help="protocols (default TCP,UDP,SCTP)",
+    )
+    cmd.add_argument(
+        "--policy-path", default="", help="create policies from this file/dir first"
+    )
+    cmd.add_argument(
+        "--all-available", action="store_true",
+        help="probe all available (port, protocol) server combinations",
+    )
+    cmd.add_argument("--probe-port", default=None, help="port to probe (int or name)")
+    cmd.add_argument("--probe-protocol", default="TCP", help="protocol to probe")
+    cmd.add_argument(
+        "--probe-mode", default=PROBE_MODE_SERVICE_NAME, choices=[str(m) for m in ALL_PROBE_MODES]
+    )
+    cmd.add_argument(
+        "--engine", default="tpu", choices=["oracle", "tpu"], help="simulated engine"
+    )
+    cmd.add_argument(
+        "--pod-creation-timeout-seconds", type=int, default=60, help="pod creation timeout"
+    )
+    cmd.set_defaults(func=run_probe)
+
+
+def run_probe(args) -> int:
+    namespaces = args.server_namespace or ["x", "y", "z"]
+    pods = args.server_pod or ["a", "b", "c"]
+    ports = args.server_port or [80, 81]
+    protocols = [p.upper() for p in (args.server_protocol or ["TCP", "UDP", "SCTP"])]
+
+    if args.mock:
+        kubernetes: IKubernetes = MockKubernetes(1.0)
+    else:
+        from ..kube.kubectl import KubectlKubernetes
+
+        kubernetes = KubectlKubernetes(args.context)
+
+    resources = Resources.new_default(
+        kubernetes,
+        namespaces,
+        pods,
+        ports,
+        protocols,
+        pod_creation_timeout_seconds=args.pod_creation_timeout_seconds,
+    )
+    if args.mock and args.perfect_cni:
+        from ..kube.mockcni import PolicyAwareMockExec
+
+        kubernetes.exec_verdict_fn = PolicyAwareMockExec(kubernetes)
+
+    actions = [read_network_policies(namespaces)]
+    if args.policy_path:
+        for policy in load_policies_from_path(args.policy_path):
+            actions.append(create_policy(policy))
+
+    if args.all_available or args.probe_port is None:
+        probe_config = ProbeConfig.all_available_config(ProbeMode(args.probe_mode))
+    else:
+        port_str = args.probe_port
+        port = IntOrString(int(port_str)) if port_str.isdigit() else IntOrString(port_str)
+        probe_config = ProbeConfig.port_protocol_config(
+            port, args.probe_protocol.upper(), ProbeMode(args.probe_mode)
+        )
+
+    test_case = TestCase(
+        description="one-off probe",
+        tags=StringSet(),
+        steps=[TestStep(probe=probe_config, actions=actions)],
+    )
+    config = InterpreterConfig(
+        kube_probe_retries=0,
+        perturbation_wait_seconds=0,
+        simulated_engine=args.engine,
+        pod_wait_timeout_seconds=args.pod_creation_timeout_seconds,
+    )
+    interpreter = Interpreter(kubernetes, resources, config)
+    result = interpreter.execute_test_case(test_case)
+    printer = Printer(noisy=True)
+    printer.print_test_case_result(result)
+    return 0

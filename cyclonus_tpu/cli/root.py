@@ -1,0 +1,58 @@
+"""Argument parsing and command dispatch (reference: pkg/cli/root.go)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+from .. import __version__
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cyclonus-tpu",
+        description="TPU-native kubernetes network policy explainer, prober, "
+        "and conformance-test generator",
+    )
+    parser.add_argument(
+        "-v",
+        "--verbosity",
+        default="info",
+        choices=["debug", "info", "warn", "error"],
+        help="log level",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    from .analyze import setup_analyze
+    from .generate import setup_generate
+    from .probe_cmd import setup_probe
+
+    setup_analyze(sub)
+    setup_generate(sub)
+    setup_probe(sub)
+
+    version_cmd = sub.add_parser("version", help="print version information")
+    version_cmd.set_defaults(func=_run_version)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level={"debug": logging.DEBUG, "info": logging.INFO, "warn": logging.WARNING,
+               "error": logging.ERROR}[args.verbosity],
+        format="%(levelname)s %(name)s: %(message)s",
+    )
+    return args.func(args) or 0
+
+
+def _run_version(args) -> int:
+    import jax
+
+    print(f"cyclonus-tpu version {__version__}")
+    print(f"jax {jax.__version__}, backend {jax.default_backend()}, "
+          f"{len(jax.devices())} device(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

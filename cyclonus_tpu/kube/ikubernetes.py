@@ -152,11 +152,14 @@ class MockKubernetes(IKubernetes):
         self.pass_rate = pass_rate
         self._pod_id = 1
         self._rng = random.Random(seed)
+        # bumped on every netpol mutation; lets policy-aware exec hooks
+        # cache their compiled policy (see kube.mockcni)
+        self.policy_rev = 0
         # Optional policy-aware exec hook with signature
         # (namespace, pod, container, command) -> bool (True = connect
-        # succeeded); when set, exec verdicts come from it instead of
-        # pass_rate.
-        self.exec_verdict_fn: Optional[Callable[[str, str, str, List[str]], bool]] = None
+        # succeeded) OR a full (stdout, stderr, command_error) tuple; when
+        # set, exec verdicts come from it instead of pass_rate.
+        self.exec_verdict_fn: Optional[Callable[[str, str, str, List[str]], object]] = None
 
     def _ns(self, namespace: str) -> MockNamespace:
         if namespace in self.namespaces:
@@ -192,6 +195,7 @@ class MockKubernetes(IKubernetes):
                 f"network policy {policy.namespace}/{policy.name} already present"
             )
         ns.netpols[policy.name] = policy
+        self.policy_rev += 1
         return policy
 
     def get_network_policies_in_namespace(self, namespace: str) -> List[NetworkPolicy]:
@@ -204,6 +208,7 @@ class MockKubernetes(IKubernetes):
                 f"network policy {policy.namespace}/{policy.name} not found"
             )
         ns.netpols[policy.name] = policy
+        self.policy_rev += 1
         return policy
 
     def delete_network_policy(self, namespace: str, name: str) -> None:
@@ -211,9 +216,11 @@ class MockKubernetes(IKubernetes):
         if name not in ns.netpols:
             raise KubeError(f"network policy {namespace}/{name} not found")
         del ns.netpols[name]
+        self.policy_rev += 1
 
     def delete_all_network_policies_in_namespace(self, namespace: str) -> None:
         self._ns(namespace).netpols = {}
+        self.policy_rev += 1
 
     # services
 
@@ -287,8 +294,12 @@ class MockKubernetes(IKubernetes):
         if not any(c.name == container for c in pod_obj.containers):
             raise KubeError(f"container {namespace}/{pod}/{container} not found")
         if self.exec_verdict_fn is not None:
-            ok = self.exec_verdict_fn(namespace, pod, container, command)
-            return ("", "", None if ok else "mock verdict: blocked")
+            verdict = self.exec_verdict_fn(namespace, pod, container, command)
+            if isinstance(verdict, tuple):
+                # hook speaks the full (stdout, stderr, command_error)
+                # protocol (e.g. the /worker batch prober)
+                return verdict
+            return ("", "", None if verdict else "mock verdict: blocked")
         if self._rng.random() > self.pass_rate:
             return ("", "", "mock call randomly failed")
         return ("", "", None)

@@ -1,0 +1,5 @@
+"""Static + resolved-policy lint checks (reference: pkg/linter)."""
+
+from .checks import Check, Warning, lint, warnings_table
+
+__all__ = ["Check", "Warning", "lint", "warnings_table"]

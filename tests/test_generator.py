@@ -1,0 +1,122 @@
+"""L4 tests: golden counts for every case family (the reference's cheap
+regression net over the whole generator, testcasegenerator_tests.go:11-24)
+plus tag taxonomy and feature extraction checks."""
+
+from cyclonus_tpu.generator import TestCaseGenerator, count_test_cases_by_tag
+from cyclonus_tpu.generator.tags import StringSet, TAG_DENY_ALL, TAG_RULE, validate_tags
+import pytest
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return TestCaseGenerator(True, "1.2.3.4", ["x", "y", "z"], [], [])
+
+
+class TestGoldenCounts:
+    def test_family_counts(self, gen):
+        assert len(gen.peers_test_cases()) == 112
+        assert len(gen.action_test_cases()) == 6
+        assert len(gen.rules_test_cases()) == 4
+        assert len(gen.upstream_e2e_test_cases()) == 13
+        assert len(gen.target_test_cases()) == 6
+        assert len(gen.example_test_cases()) == 1
+        assert len(gen.port_protocol_test_cases()) == 58
+        assert len(gen.conflict_test_cases()) == 16
+
+    def test_total(self, gen):
+        assert len(gen.generate_test_cases()) == 216
+
+    def test_default_cli_excludes(self, gen):
+        # cli/generate.go:66 default excludes
+        g = TestCaseGenerator(
+            True,
+            "1.2.3.4",
+            ["x", "y", "z"],
+            [],
+            ["multi-peer", "upstream-e2e", "example", "end-port"],
+        )
+        # end-port isn't a tag in this taxonomy; filter with the valid ones
+        g.excluded_tags = ["multi-peer", "upstream-e2e", "example"]
+        filtered = g.generate_test_cases()
+        assert len(filtered) == 216 - 90 - 13 - 1
+
+    def test_tag_filter_include(self):
+        g = TestCaseGenerator(True, "1.2.3.4", ["x", "y", "z"], [TAG_DENY_ALL], [])
+        cases = g.generate_test_cases()
+        assert all(TAG_DENY_ALL in tc.tags for tc in cases)
+        assert len(cases) > 0
+
+
+class TestTags:
+    def test_sub_adds_primary(self):
+        s = StringSet.of(TAG_DENY_ALL)
+        assert TAG_RULE in s
+        assert TAG_DENY_ALL in s
+
+    def test_validate(self):
+        validate_tags(["deny-all", "rule"])
+        with pytest.raises(ValueError):
+            validate_tags(["nope-not-a-tag"])
+
+    def test_counts_by_tag(self, gen):
+        counts = count_test_cases_by_tag(gen.generate_all_test_cases())
+        assert counts["deny-all"] > 0
+        assert counts["multi-peer"] == 90
+
+
+class TestFeatures:
+    def test_base_policy_features(self, gen):
+        tc = gen.action_test_cases()[0]
+        features = tc.get_features()
+        assert "action: create policy" in features["action"]
+        assert "action: delete policy" in features["action"]
+        assert "policy with both ingress and egress" in features["general"]
+        assert "1 rule" in features["ingress"]
+        assert "2+ rules" in features["egress"]
+        assert "numbered port" in features["ingress"]
+
+    def test_ipblock_features(self, gen):
+        # find a peers case with ipblock-with-except
+        for tc in gen.peers_test_cases():
+            if "ip-block-with-except" in tc.tags and "multi-peer" not in tc.tags:
+                features = tc.get_features()
+                direction = (
+                    "ingress" if "ingress" in tc.tags else "egress"
+                )
+                assert "IPBlock with except" in features[direction]
+                return
+        raise AssertionError("no ipblock-with-except case found")
+
+    def test_descriptions_nonempty(self, gen):
+        for tc in gen.generate_all_test_cases():
+            assert tc.description
+
+
+class TestCaseStructure:
+    def test_policies_buildable(self, gen):
+        # every generated policy must compile through the matcher
+        from cyclonus_tpu.matcher import build_network_policies
+
+        for tc in gen.generate_all_test_cases():
+            for step in tc.steps:
+                for action in step.actions:
+                    if action.create_policy is not None:
+                        build_network_policies(True, [action.create_policy.policy])
+                    if action.update_policy is not None:
+                        build_network_policies(True, [action.update_policy.policy])
+
+    def test_ipblock_cases_derive_from_pod_ip(self):
+        g = TestCaseGenerator(True, "192.168.3.77", ["x", "y", "z"], [], [])
+        found = False
+        for tc in g.peers_test_cases():
+            for step in tc.steps:
+                for action in step.actions:
+                    if action.create_policy is None:
+                        continue
+                    pol = action.create_policy.policy
+                    for rule in pol.spec.ingress:
+                        for peer in rule.from_:
+                            if peer.ip_block is not None:
+                                assert peer.ip_block.cidr == "192.168.3.0/24"
+                                found = True
+        assert found
