@@ -72,6 +72,26 @@ def setup_generate(sub) -> None:
     cmd.add_argument(
         "--max-cases", type=int, default=0, help="cap the number of cases (0 = all)"
     )
+    cmd.add_argument(
+        "--journal",
+        default="",
+        help="JSONL journal of per-case results (crash-safe, appended per case)",
+    )
+    cmd.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip test cases already recorded in --journal",
+    )
+    cmd.add_argument(
+        "--jax-profile",
+        default="",
+        help="write a jax profiler trace (TensorBoard/XProf) to this directory",
+    )
+    cmd.add_argument(
+        "--phase-stats",
+        action="store_true",
+        help="print per-phase wall-clock timers at the end of the run",
+    )
     cmd.set_defaults(func=run_generate)
 
 
@@ -148,12 +168,44 @@ def run_generate(args) -> int:
     interpreter = Interpreter(kubernetes, resources, config)
     printer = Printer(noisy=args.noisy, ignore_loopback=args.ignore_loopback)
 
-    for i, tc in enumerate(cases):
-        print(f"starting test case #{i + 1} ({tc.description})")
-        result = interpreter.execute_test_case(tc)
-        printer.print_test_case_result(result)
+    if args.resume and not args.journal:
+        raise SystemExit("--resume requires --journal")
+    journal = None
+    if args.journal:
+        from ..connectivity.journal import Journal
+
+        journal = Journal(args.journal)
+        if args.resume and journal.completed():
+            print(f"resuming: {len(journal.completed())} case(s) already journaled")
+
+    from ..utils.tracing import jax_profile, render_stats
+
+    with jax_profile(args.jax_profile):
+        for i, tc in enumerate(cases):
+            # descriptions are not unique across cases; the index in the
+            # deterministic generated order disambiguates (see journal.py)
+            case_key = f"{i}:{tc.description}"
+            if journal is not None and args.resume and journal.is_completed(
+                case_key
+            ):
+                print(f"skipping journaled test case #{i + 1} ({tc.description})")
+                continue
+            print(f"starting test case #{i + 1} ({tc.description})")
+            result = interpreter.execute_test_case(tc)
+            printer.print_test_case_result(result)
+            if journal is not None:
+                journal.record(
+                    tc.description,
+                    passed=result.passed(args.ignore_loopback),
+                    step_count=len(result.steps),
+                    tags=tc.tags.keys_sorted(),
+                    error=str(result.err) if result.err else "",
+                    key=case_key,
+                )
 
     printer.print_summary()
+    if args.phase_stats:
+        print(f"\nphase timers:\n{render_stats()}")
 
     if args.cleanup_namespaces:
         for ns in namespaces:

@@ -1,0 +1,23 @@
+"""`recipes` subcommand (reference: cmd/recipes/main.go -> recipes.Run)."""
+
+from __future__ import annotations
+
+
+def setup_recipes(sub) -> None:
+    cmd = sub.add_parser(
+        "recipes", help="run the canned policy recipe scenarios"
+    )
+    cmd.add_argument(
+        "--engine",
+        default="tpu",
+        choices=["oracle", "tpu"],
+        help="simulated engine",
+    )
+    cmd.set_defaults(func=_run)
+
+
+def _run(args) -> int:
+    from ..recipes import run_all_recipes
+
+    run_all_recipes(engine=args.engine)
+    return 0

@@ -28,10 +28,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .analyze import setup_analyze
     from .generate import setup_generate
     from .probe_cmd import setup_probe
+    from .recipes_cmd import setup_recipes
 
     setup_analyze(sub)
     setup_generate(sub)
     setup_probe(sub)
+    setup_recipes(sub)
 
     version_cmd = sub.add_parser("version", help="print version information")
     version_cmd.set_defaults(func=_run_version)

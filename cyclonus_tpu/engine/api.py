@@ -16,6 +16,7 @@ import numpy as np
 
 from ..kube.ipaddr import is_ip_address_match_for_ip_block
 from ..matcher.core import Policy
+from ..utils.tracing import phase
 from .encoding import PEER_IP, PolicyEncoding, _DirectionEncoding, encode_policy
 
 
@@ -52,7 +53,10 @@ class GridVerdict:
 
     def _materialize(self, name: str) -> np.ndarray:
         if name not in self._np:
-            self._np[name] = np.asarray(getattr(self, name + "_dev"))
+            # NB: JAX dispatch is async, so this fetch phase also absorbs
+            # any still-running device execution time (see engine.dispatch)
+            with phase("grid.fetch"):
+                self._np[name] = np.asarray(getattr(self, name + "_dev"))
         return self._np[name]
 
     @property
@@ -95,13 +99,20 @@ class GridVerdict:
         return np.asarray(out)
 
     def allow_stats(self) -> Dict[str, float]:
-        """Device-side aggregate: mean allow rate per grid."""
-        import jax.numpy as jnp
+        """Device-side aggregate: mean allow rate per grid.  One fused
+        execution and one 12-byte transfer — separate readbacks each pay a
+        full round trip over a tunneled TPU."""
+        if self.ingress_dev.shape[0] == 0:
+            return {"ingress": 0.0, "egress": 0.0, "combined": 0.0}
+        from .kernel import grid_stats_kernel
 
+        stats = np.asarray(
+            grid_stats_kernel(self.ingress_dev, self.egress_dev, self.combined_dev)
+        )
         return {
-            "ingress": float(jnp.mean(self.ingress_dev)),
-            "egress": float(jnp.mean(self.egress_dev)),
-            "combined": float(jnp.mean(self.combined_dev)),
+            "ingress": float(stats[0]),
+            "egress": float(stats[1]),
+            "combined": float(stats[2]),
         }
 
 
@@ -140,8 +151,9 @@ class TpuPolicyEngine:
         pods: Sequence[Tuple[str, str, Dict[str, str], str]],
         namespaces: Dict[str, Dict[str, str]],
     ):
-        self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
-        self._tensors = self._build_tensors()
+        with phase("engine.encode"):
+            self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
+            self._tensors = self._build_tensors()
         self._device_tensors = None  # lazily device_put once
         self._has_ip_peers = (
             bool(np.any(self.encoding.ingress.peer_kind == PEER_IP))
@@ -222,7 +234,6 @@ class TpuPolicyEngine:
         """Single-device evaluation of the full N x N x Q verdict grid.
         Results stay on device (see GridVerdict)."""
         import jax
-        import jax.numpy as jnp
 
         from .kernel import evaluate_grid_kernel
 
@@ -233,19 +244,23 @@ class TpuPolicyEngine:
             return GridVerdict(self.pod_keys, [], empty, empty.copy(), empty.copy())
         q_port, q_name, q_proto = self._port_case_arrays(cases)
         if self._device_tensors is None:
-            self._device_tensors = jax.device_put(self._tensors)
+            with phase("engine.device_put"):
+                self._device_tensors = jax.device_put(self._tensors)
         tensors = dict(self._device_tensors)
         tensors["q_port"] = q_port
         tensors["q_name"] = q_name
         tensors["q_proto"] = q_proto
-        out = evaluate_grid_kernel(tensors)
-        # kernel layout: [target-side, peer-side, q] -> [q, ...] on device
+        # dispatch-only timing: jit calls return once enqueued (async);
+        # device execution time lands in grid.fetch / allow_stats
+        with phase("engine.dispatch"):
+            out = evaluate_grid_kernel(tensors)
+        # kernel emits [q, ...] layout directly: one device execution total
         return GridVerdict(
             self.pod_keys,
             list(cases),
-            jnp.moveaxis(out["ingress"], -1, 0),
-            jnp.moveaxis(out["egress"], -1, 0),
-            jnp.moveaxis(out["combined"], -1, 0),
+            out["ingress"],
+            out["egress"],
+            out["combined"],
         )
 
     def evaluate_grid_sharded(
@@ -268,9 +283,10 @@ class TpuPolicyEngine:
         tensors["q_proto"] = q_proto
         import jax.numpy as jnp
 
-        ingress, egress, combined = evaluate_grid_sharded(
-            tensors, self.encoding.cluster.n_pods, mesh=mesh
-        )
+        with phase("engine.dispatch_sharded"):
+            ingress, egress, combined = evaluate_grid_sharded(
+                tensors, self.encoding.cluster.n_pods, mesh=mesh
+            )
         return GridVerdict(
             self.pod_keys,
             list(cases),

@@ -225,7 +225,7 @@ def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
       sel_*: selector tables; pod_*: cluster pod arrays; ns_kv/ns_key;
       ingress/egress: per-direction encodings (dicts incl. m_tp);
       q_port/q_name/q_proto: [Q] port cases.
-    Returns ingress[d, s, q], egress[s, d, q], combined[s, d, q].
+    Returns ingress[q, d, s], egress[q, s, d], combined[q, s, d].
     """
     selpod = selector_match(
         tensors["sel_req_kv"],
@@ -273,8 +273,20 @@ def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
 
     # ingress is indexed [dst, src, q]; egress [src, dst, q]
     combined = out["egress"] & jnp.swapaxes(out["ingress"], 0, 1)
+    # [q, ., .] layout for the GridVerdict API; transposing here keeps the
+    # whole evaluation a single device execution (each extra dispatch costs
+    # a full round trip on a tunneled TPU).
     return {
-        "ingress": out["ingress"],
-        "egress": out["egress"],
-        "combined": combined,
+        "ingress": jnp.moveaxis(out["ingress"], -1, 0),
+        "egress": jnp.moveaxis(out["egress"], -1, 0),
+        "combined": jnp.moveaxis(combined, -1, 0),
     }
+
+
+@jax.jit
+def grid_stats_kernel(ingress, egress, combined) -> jnp.ndarray:
+    """[3] f32 mean allow-rates — one execution, one scalar-sized
+    transfer (vs three separate float() readbacks)."""
+    return jnp.stack(
+        [jnp.mean(ingress), jnp.mean(egress), jnp.mean(combined)]
+    )

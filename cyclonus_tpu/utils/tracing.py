@@ -1,0 +1,85 @@
+"""Phase timers + JAX profiler hooks.
+
+The reference has no tracing/profiling at all (SURVEY.md section 5); its
+closest analog is logrus trace-level logging of each simulated verdict
+(jobrunner.go:80).  Here tracing is first-class: every engine evaluation
+records per-phase wall-clock (compile/encode/device_put/execute/fetch) in a
+process-local registry, and `jax_profile` wraps a block in a
+jax.profiler trace for TensorBoard/XProf.
+
+Usage:
+    with phase("encode"):
+        ...
+    stats()        -> {"encode": {"count": 3, "total_s": ..., "max_s": ...}}
+    reset()
+
+    with jax_profile("/tmp/trace"):   # no-op when dir is falsy
+        engine.evaluate_grid(cases)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+logger = logging.getLogger("cyclonus.trace")
+
+_lock = threading.Lock()
+_phases: Dict[str, Dict[str, float]] = {}
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Accumulate wall-clock under `name`; nestable and thread-safe."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            rec = _phases.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            rec["count"] += 1
+            rec["total_s"] += dt
+            rec["max_s"] = max(rec["max_s"], dt)
+        logger.debug("phase %s: %.4fs", name, dt)
+
+
+def stats() -> Dict[str, Dict[str, float]]:
+    with _lock:
+        return {k: dict(v) for k, v in _phases.items()}
+
+
+def reset() -> None:
+    with _lock:
+        _phases.clear()
+
+
+def render_stats() -> str:
+    rows = sorted(stats().items())
+    if not rows:
+        return "(no phases recorded)"
+    out = [f"{'phase':<24}{'count':>8}{'total_s':>12}{'max_s':>10}"]
+    for name, rec in rows:
+        out.append(
+            f"{name:<24}{int(rec['count']):>8}{rec['total_s']:>12.4f}"
+            f"{rec['max_s']:>10.4f}"
+        )
+    return "\n".join(out)
+
+
+@contextlib.contextmanager
+def jax_profile(trace_dir: Optional[str]) -> Iterator[None]:
+    """Wrap a block in jax.profiler.trace(trace_dir); no-op when falsy."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+    logger.info("jax profiler trace written to %s", trace_dir)

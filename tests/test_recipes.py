@@ -1,0 +1,118 @@
+"""Recipe scenarios: semantic golden checks + oracle/tpu engine agreement
+(reference: pkg/recipes — untested there; tested here)."""
+
+import pytest
+
+from cyclonus_tpu.probe.connectivity import (
+    CONNECTIVITY_ALLOWED,
+    CONNECTIVITY_BLOCKED,
+)
+from cyclonus_tpu.recipes import ALL_RECIPES
+
+
+def recipe(name):
+    for r in ALL_RECIPES:
+        if r.name == name:
+            return r
+    raise KeyError(name)
+
+
+def combined(table, fr, to):
+    (jr,) = table.get(fr, to).job_results.values()
+    return jr.combined
+
+
+def test_recipe_count():
+    assert len(ALL_RECIPES) == 15
+
+
+def test_all_recipes_parse_policies():
+    for r in ALL_RECIPES:
+        policies = r.policies()
+        assert policies, r.name
+        for p in policies:
+            assert p.name
+
+
+def test_01_deny_all_to_web():
+    table = recipe("01-deny-all-to-app").run_probe(engine="oracle")
+    # web pod unreachable from anyone (incl. itself); everything else open
+    for fr in ("x/a", "default/a", "y/c", "default/b"):
+        assert combined(table, fr, "default/b") == CONNECTIVITY_BLOCKED
+    assert combined(table, "x/a", "y/c") == CONNECTIVITY_ALLOWED
+    assert combined(table, "default/b", "x/a") == CONNECTIVITY_ALLOWED
+
+
+def test_02a_allow_all_overrides_deny_all():
+    table = recipe("02a-allow-all-to-app").run_probe(engine="oracle")
+    for fr in ("x/a", "default/a", "y/c"):
+        assert combined(table, fr, "default/b") == CONNECTIVITY_ALLOWED
+
+
+def test_04_deny_from_other_namespaces():
+    table = recipe("04-deny-other-namespaces").run_probe(engine="oracle")
+    assert combined(table, "secondary/a", "secondary/b") == CONNECTIVITY_ALLOWED
+    assert combined(table, "x/a", "secondary/b") == CONNECTIVITY_BLOCKED
+    assert combined(table, "default/a", "secondary/b") == CONNECTIVITY_BLOCKED
+    assert combined(table, "secondary/a", "x/a") == CONNECTIVITY_ALLOWED
+
+
+def test_06_allow_prod_namespace_only():
+    table = recipe("06-allow-prod-namespace").run_probe(engine="oracle")
+    # x is labelled purpose=production
+    assert combined(table, "x/a", "default/b") == CONNECTIVITY_ALLOWED
+    assert combined(table, "y/a", "default/b") == CONNECTIVITY_BLOCKED
+    assert combined(table, "default/a", "default/b") == CONNECTIVITY_BLOCKED
+
+
+def test_07_ns_and_pod_selector():
+    table = recipe("07-allow-monitoring-pods").run_probe(engine="oracle")
+    # only type=monitoring pods in team=operations namespaces
+    assert combined(table, "x/a", "default/b") == CONNECTIVITY_ALLOWED
+    assert combined(table, "y/a", "default/b") == CONNECTIVITY_ALLOWED
+    assert combined(table, "x/b", "default/b") == CONNECTIVITY_BLOCKED
+    # default/a is type=monitoring but default ns has no team=operations
+    assert combined(table, "default/a", "default/b") == CONNECTIVITY_BLOCKED
+
+
+def test_09_port_gate():
+    table = recipe("09-allow-port-5000").run_probe(engine="oracle")
+    # bare podSelector peer matches only the policy's own namespace
+    assert combined(table, "default/a", "default/b") == CONNECTIVITY_ALLOWED
+    assert combined(table, "x/a", "default/b") == CONNECTIVITY_BLOCKED
+    assert combined(table, "default/c", "default/b") == CONNECTIVITY_BLOCKED
+
+
+def test_11_deny_egress():
+    table = recipe("11-deny-egress").run_probe(engine="oracle")
+    assert combined(table, "default/b", "x/a") == CONNECTIVITY_BLOCKED
+    assert combined(table, "x/a", "default/b") == CONNECTIVITY_ALLOWED
+
+
+def test_11a_unserved_port_buckets_as_invalid():
+    # the probe targets TCP 53 but every container serves only port 80:
+    # jobs land in the bad-port-protocol bucket (resources.go:284-334
+    # semantics), same as the reference running recipe 11_2
+    from cyclonus_tpu.probe.connectivity import (
+        CONNECTIVITY_INVALID_PORT_PROTOCOL,
+    )
+
+    table = recipe("11a-deny-egress-allow-dns").run_probe(engine="oracle")
+    assert (
+        combined(table, "default/b", "x/a") == CONNECTIVITY_INVALID_PORT_PROTOCOL
+    )
+
+
+def test_14_cluster_internal_egress_allowed():
+    table = recipe("14-deny-external-egress").run_probe(engine="oracle")
+    # namespaceSelector {} allows all in-cluster egress on any port
+    assert combined(table, "default/b", "x/a") == CONNECTIVITY_ALLOWED
+
+
+@pytest.mark.parametrize("r", ALL_RECIPES, ids=lambda r: r.name)
+def test_oracle_tpu_engine_agreement(r):
+    oracle = r.run_probe(engine="oracle")
+    tpu = r.run_probe(engine="tpu")
+    assert oracle.render_table() == tpu.render_table()
+    assert oracle.render_ingress() == tpu.render_ingress()
+    assert oracle.render_egress() == tpu.render_egress()
