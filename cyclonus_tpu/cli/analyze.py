@@ -56,7 +56,7 @@ def setup_analyze(sub) -> None:
     cmd.add_argument(
         "--engine",
         default="tpu",
-        choices=["oracle", "tpu"],
+        choices=["oracle", "tpu", "native"],
         help="simulated engine for probe mode",
     )
     cmd.set_defaults(func=run_analyze)

@@ -57,7 +57,7 @@ def setup_generate(sub) -> None:
     cmd.add_argument("--ignore-loopback", action="store_true", help="ignore loopback calls")
     cmd.add_argument("--noisy", action="store_true", help="print tables for every step")
     cmd.add_argument(
-        "--engine", default="tpu", choices=["oracle", "tpu"], help="simulated engine"
+        "--engine", default="tpu", choices=["oracle", "tpu", "native"], help="simulated engine"
     )
     cmd.add_argument(
         "--allow-dns",
@@ -99,6 +99,9 @@ DEFAULT_EXCLUDE = ["multi-peer", "upstream-e2e", "example"]
 
 
 def run_generate(args) -> int:
+    if args.resume and not args.journal:
+        # validate before any cluster resources get created
+        raise SystemExit("--resume requires --journal")
     namespaces = args.server_namespace or ["x", "y", "z"]
     pods = args.server_pod or ["a", "b", "c"]
     ports = args.server_port or [80, 81]
@@ -168,8 +171,6 @@ def run_generate(args) -> int:
     interpreter = Interpreter(kubernetes, resources, config)
     printer = Printer(noisy=args.noisy, ignore_loopback=args.ignore_loopback)
 
-    if args.resume and not args.journal:
-        raise SystemExit("--resume requires --journal")
     journal = None
     if args.journal:
         from ..connectivity.journal import Journal
@@ -185,7 +186,7 @@ def run_generate(args) -> int:
             # descriptions are not unique across cases; the index in the
             # deterministic generated order disambiguates (see journal.py)
             case_key = f"{i}:{tc.description}"
-            if journal is not None and args.resume and journal.is_completed(
+            if journal is not None and args.resume and journal.should_skip(
                 case_key
             ):
                 print(f"skipping journaled test case #{i + 1} ({tc.description})")

@@ -48,6 +48,12 @@ class Journal:
     def is_completed(self, key: str) -> bool:
         return key in self._completed
 
+    def should_skip(self, key: str) -> bool:
+        """Resume skips cases that ran to completion; cases journaled with a
+        non-empty error (infra flakes, crashes mid-case) are re-run."""
+        entry = self._completed.get(key)
+        return entry is not None and not entry.get("error")
+
     def record(
         self,
         description: str,

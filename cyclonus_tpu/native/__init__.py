@@ -1,0 +1,21 @@
+"""Native (C++) grid evaluator: host-side fast path + parity triangulation.
+
+The reference is pure Go with no native components (SURVEY.md section 2);
+in this framework the native layer is a third, independent implementation
+of the policy decision procedure (besides the Python scalar oracle and the
+JAX/TPU kernel) used as a fast CPU backend (engine='native') and in parity
+fuzzing.  Builds on demand with g++; callers fall back to the Python
+oracle when unavailable.
+"""
+
+from .build import NativeUnavailable, load_library
+from .bridge import NativeUnsupported
+from .evaluate import evaluate_grid_native, native_available
+
+__all__ = [
+    "NativeUnavailable",
+    "NativeUnsupported",
+    "evaluate_grid_native",
+    "load_library",
+    "native_available",
+]

@@ -1,0 +1,374 @@
+"""Flat-buffer bridge from the matcher IR to the C++ grid evaluator.
+
+Packs the semantic matcher objects (matcher/core.py — NOT the TPU tensor
+encoding, so the native path is an independent implementation for
+triangulation) into one contiguous int32 buffer; fast_oracle.cpp unpacks it
+in the same fixed order.  IPv4-only: any IPv6/unparseable pod IP or CIDR
+raises NativeUnsupported and callers fall back to the Python oracle.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..matcher.core import (
+    AllPeersMatcher,
+    AllPodMatcher,
+    AllPortMatcher,
+    AllNamespaceMatcher,
+    ExactNamespaceMatcher,
+    IPPeerMatcher,
+    LabelSelectorNamespaceMatcher,
+    LabelSelectorPodMatcher,
+    PodPeerMatcher,
+    Policy,
+    PortsForAllPeersMatcher,
+    SpecificPortMatcher,
+)
+from ..kube.labels import serialize_label_selector
+from ..kube.netpol import LabelSelector
+
+# enums mirrored in fast_oracle.cpp — keep in lockstep
+PEER_ALL, PEER_ALL_PORTS, PEER_IP, PEER_POD = 0, 1, 2, 3
+NS_EXACT, NS_SELECTOR, NS_ALL = 0, 1, 2
+POD_ALL, POD_SELECTOR = 0, 1
+EXP_IN, EXP_NOT_IN, EXP_EXISTS, EXP_DOES_NOT_EXIST = 0, 1, 2, 3
+PORT_NIL, PORT_INT, PORT_NAMED = 0, 1, 2
+
+_OP_CODES = {
+    "In": EXP_IN,
+    "NotIn": EXP_NOT_IN,
+    "Exists": EXP_EXISTS,
+    "DoesNotExist": EXP_DOES_NOT_EXIST,
+}
+
+
+class NativeUnsupported(Exception):
+    """Problem shape the native evaluator does not handle (e.g. IPv6)."""
+
+
+class _Vocab:
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+
+    def id(self, s: str) -> int:
+        if s not in self._ids:
+            self._ids[s] = len(self._ids)
+        return self._ids[s]
+
+    def get(self, s: str, default: int = -1) -> int:
+        return self._ids.get(s, default)
+
+
+def _parse_v4_cidr(cidr: str) -> Tuple[int, int]:
+    net = ipaddress.ip_network(cidr, strict=False)
+    if net.version != 4:
+        raise NativeUnsupported(f"IPv6 CIDR {cidr}")
+    return int(net.network_address), int(net.netmask)
+
+
+def _i32(v: int) -> int:
+    """Reinterpret a uint32 as int32 (numpy refuses out-of-range casts)."""
+    v &= 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+class _Packer:
+    def __init__(self):
+        self.parts: List[np.ndarray] = []
+
+    def scalar(self, v: int) -> None:
+        self.parts.append(np.array([v], dtype=np.int32))
+
+    def arr(self, values) -> None:
+        self.parts.append(np.asarray(values, dtype=np.int32).ravel())
+
+    def buffer(self) -> np.ndarray:
+        return (
+            np.concatenate(self.parts)
+            if self.parts
+            else np.zeros((0,), dtype=np.int32)
+        )
+
+
+class _SelectorTable:
+    """Dedup LabelSelectors; flatten to CSR req/exp arrays."""
+
+    def __init__(self, kv: _Vocab, key: _Vocab):
+        self.kv = kv
+        self.key = key
+        self._index: Dict[str, int] = {}
+        self.selectors: List[LabelSelector] = []
+
+    def add(self, sel: LabelSelector) -> int:
+        k = serialize_label_selector(sel)
+        if k not in self._index:
+            self._index[k] = len(self.selectors)
+            self.selectors.append(sel)
+        return self._index[k]
+
+    def pack(self, p: _Packer) -> None:
+        req_off, req = [0], []
+        exp_off = [0]
+        exp_op, exp_key, exp_val_off, exp_val = [], [], [0], []
+        for sel in self.selectors:
+            for k, v in sorted((sel.match_labels or {}).items()):
+                req.append(self.kv.id(f"{k}={v}"))
+            req_off.append(len(req))
+            for e in sel.match_expressions or []:
+                exp_op.append(_OP_CODES[e.operator])
+                exp_key.append(self.key.id(e.key))
+                for v in e.values or []:
+                    exp_val.append(self.kv.id(f"{e.key}={v}"))
+                exp_val_off.append(len(exp_val))
+            exp_off.append(len(exp_op))
+        p.arr(req_off)
+        p.arr(req)
+        p.arr(exp_off)
+        p.arr(exp_op)
+        p.arr(exp_key)
+        p.arr(exp_val_off)
+        p.arr(exp_val)
+
+
+def _pack_labels(p: _Packer, label_sets, kv: _Vocab, key: _Vocab) -> None:
+    kv_off, kvs = [0], []
+    key_off, keys = [0], []
+    for labels in label_sets:
+        for k, v in sorted((labels or {}).items()):
+            kvs.append(kv.id(f"{k}={v}"))
+            keys.append(key.id(k))
+        kv_off.append(len(kvs))
+        key_off.append(len(keys))
+    p.arr(kv_off)
+    p.arr(kvs)
+    p.arr(key_off)
+    p.arr(keys)
+
+
+def _pack_direction(
+    p: _Packer,
+    targets,
+    sel_table: _SelectorTable,
+    ns_id: _Vocab,
+    port_name: _Vocab,
+    proto: _Vocab,
+) -> None:
+    tgt_ns, tgt_sel, tgt_peer_off = [], [], [0]
+    kind, ns_kind, ns_exact, ns_sel, pod_kind, pod_sel = [], [], [], [], [], []
+    ip_base, ip_mask = [], []
+    ex_off, ex_base, ex_mask = [0], [], []
+    port_all = []
+    pi_off, pi_kind, pi_port, pi_name, pi_proto = [0], [], [], [], []
+    pr_off, pr_from, pr_to, pr_proto = [0], [], [], []
+
+    def pack_port(pm) -> None:
+        if isinstance(pm, AllPortMatcher):
+            port_all.append(1)
+        elif isinstance(pm, SpecificPortMatcher):
+            port_all.append(0)
+            for item in pm.ports:
+                if item.port is None:
+                    pi_kind.append(PORT_NIL)
+                    pi_port.append(0)
+                    pi_name.append(-2)
+                elif item.port.is_int:
+                    pi_kind.append(PORT_INT)
+                    pi_port.append(item.port.int_value)
+                    pi_name.append(-2)
+                else:
+                    pi_kind.append(PORT_NAMED)
+                    pi_port.append(0)
+                    pi_name.append(port_name.id(item.port.str_value))
+                pi_proto.append(proto.id(item.protocol))
+            for rng in pm.port_ranges:
+                pr_from.append(rng.from_port)
+                pr_to.append(rng.to_port)
+                pr_proto.append(proto.id(rng.protocol))
+        else:
+            raise NativeUnsupported(f"port matcher {type(pm).__name__}")
+        pi_off.append(len(pi_kind))
+        pr_off.append(len(pr_from))
+
+    for t in targets:
+        tgt_ns.append(ns_id.id(t.namespace))
+        tgt_sel.append(sel_table.add(t.pod_selector))
+        for peer in t.peers:
+            if isinstance(peer, AllPeersMatcher):
+                kind.append(PEER_ALL)
+                ns_kind.append(NS_ALL)
+                ns_exact.append(-1)
+                ns_sel.append(0)
+                pod_kind.append(POD_ALL)
+                pod_sel.append(0)
+                ip_base.append(0)
+                ip_mask.append(0)
+                ex_off.append(len(ex_base))
+                port_all.append(1)
+                pi_off.append(len(pi_kind))
+                pr_off.append(len(pr_from))
+            elif isinstance(peer, PortsForAllPeersMatcher):
+                kind.append(PEER_ALL_PORTS)
+                ns_kind.append(NS_ALL)
+                ns_exact.append(-1)
+                ns_sel.append(0)
+                pod_kind.append(POD_ALL)
+                pod_sel.append(0)
+                ip_base.append(0)
+                ip_mask.append(0)
+                ex_off.append(len(ex_base))
+                pack_port(peer.port)
+            elif isinstance(peer, IPPeerMatcher):
+                kind.append(PEER_IP)
+                ns_kind.append(NS_ALL)
+                ns_exact.append(-1)
+                ns_sel.append(0)
+                pod_kind.append(POD_ALL)
+                pod_sel.append(0)
+                base, mask = _parse_v4_cidr(peer.ip_block.cidr)
+                ip_base.append(_i32(base & mask))
+                ip_mask.append(_i32(mask))
+                for ex in peer.ip_block.except_ or []:
+                    b, m = _parse_v4_cidr(ex)
+                    ex_base.append(_i32(b & m))
+                    ex_mask.append(_i32(m))
+                ex_off.append(len(ex_base))
+                pack_port(peer.port)
+            elif isinstance(peer, PodPeerMatcher):
+                kind.append(PEER_POD)
+                nm = peer.namespace
+                if isinstance(nm, ExactNamespaceMatcher):
+                    ns_kind.append(NS_EXACT)
+                    ns_exact.append(ns_id.id(nm.namespace))
+                    ns_sel.append(0)
+                elif isinstance(nm, LabelSelectorNamespaceMatcher):
+                    ns_kind.append(NS_SELECTOR)
+                    ns_exact.append(-1)
+                    ns_sel.append(sel_table.add(nm.selector))
+                elif isinstance(nm, AllNamespaceMatcher):
+                    ns_kind.append(NS_ALL)
+                    ns_exact.append(-1)
+                    ns_sel.append(0)
+                else:
+                    raise NativeUnsupported(f"ns matcher {type(nm).__name__}")
+                pm = peer.pod
+                if isinstance(pm, AllPodMatcher):
+                    pod_kind.append(POD_ALL)
+                    pod_sel.append(0)
+                elif isinstance(pm, LabelSelectorPodMatcher):
+                    pod_kind.append(POD_SELECTOR)
+                    pod_sel.append(sel_table.add(pm.selector))
+                else:
+                    raise NativeUnsupported(f"pod matcher {type(pm).__name__}")
+                ip_base.append(0)
+                ip_mask.append(0)
+                ex_off.append(len(ex_base))
+                pack_port(peer.port)
+            else:
+                raise NativeUnsupported(f"peer matcher {type(peer).__name__}")
+        tgt_peer_off.append(len(kind))
+
+    p.scalar(len(targets))
+    p.scalar(len(kind))
+    p.arr(tgt_ns)
+    p.arr(tgt_sel)
+    p.arr(tgt_peer_off)
+    p.arr(kind)
+    p.arr(ns_kind)
+    p.arr(ns_exact)
+    p.arr(ns_sel)
+    p.arr(pod_kind)
+    p.arr(pod_sel)
+    p.arr(ip_base)
+    p.arr(ip_mask)
+    p.arr(ex_off)
+    p.arr(ex_base)
+    p.arr(ex_mask)
+    p.arr(port_all)
+    p.arr(pi_off)
+    p.arr(pi_kind)
+    p.arr(pi_port)
+    p.arr(pi_name)
+    p.arr(pi_proto)
+    p.arr(pr_off)
+    p.arr(pr_from)
+    p.arr(pr_to)
+    p.arr(pr_proto)
+
+
+def pack_problem(
+    policy: Policy,
+    pods: Sequence[Tuple[str, str, Dict[str, str], str]],
+    namespaces: Dict[str, Dict[str, str]],
+    cases,
+) -> np.ndarray:
+    """cases: sequence of engine.PortCase. Returns the int32 buffer."""
+    kv, key, ns_id = _Vocab(), _Vocab(), _Vocab()
+    port_name, proto = _Vocab(), _Vocab()
+    sel_table = _SelectorTable(kv, key)
+
+    ns_names = list(namespaces.keys())
+    for ns in ns_names:
+        ns_id.id(ns)  # cluster namespaces get ids [0, M)
+
+    has_ip_peer = any(
+        isinstance(peer, IPPeerMatcher)
+        for targets in (policy.ingress.values(), policy.egress.values())
+        for t in targets
+        for peer in t.peers
+    )
+
+    pod_ns, pod_ip, pod_ip_valid = [], [], []
+    for ns, _name, _labels, ip in pods:
+        if ns not in namespaces:
+            raise NativeUnsupported(f"pod namespace {ns} not in cluster map")
+        pod_ns.append(ns_id.id(ns))
+        try:
+            addr = ipaddress.ip_address(ip)
+            if addr.version != 4:
+                raise NativeUnsupported(f"IPv6 pod ip {ip}")
+            pod_ip.append(_i32(int(addr)))
+            pod_ip_valid.append(1)
+        except ValueError:
+            if has_ip_peer:
+                # the oracle and TPU engines raise in this state; silently
+                # evaluating no-match would break three-way parity
+                raise NativeUnsupported(
+                    f"unparseable pod ip {ip!r} with IPBlock peers present"
+                )
+            pod_ip.append(0)
+            pod_ip_valid.append(0)
+
+    # walk targets FIRST so selector/vocab ids are assigned before packing
+    ingress, egress = policy.sorted_targets()
+
+    p = _Packer()
+    p.scalar(len(pods))
+    p.scalar(len(ns_names))
+
+    body = _Packer()  # everything after S is known
+    body.arr(pod_ns)
+    body.arr(pod_ip)
+    body.arr(pod_ip_valid)
+    _pack_labels(body, [labels for _, _, labels, _ in pods], kv, key)
+    _pack_labels(body, [namespaces[ns] for ns in ns_names], kv, key)
+
+    dir_pack = _Packer()
+    _pack_direction(dir_pack, ingress, sel_table, ns_id, port_name, proto)
+    _pack_direction(dir_pack, egress, sel_table, ns_id, port_name, proto)
+
+    sel_pack = _Packer()
+    sel_table.pack(sel_pack)
+
+    q_pack = _Packer()
+    q_pack.arr([c.port for c in cases])
+    q_pack.arr([port_name.get(c.port_name) for c in cases])
+    q_pack.arr([proto.get(c.protocol) for c in cases])
+
+    p.scalar(len(sel_table.selectors))
+    p.scalar(len(cases))
+    p.parts += body.parts + sel_pack.parts + q_pack.parts + dir_pack.parts
+    return p.buffer()

@@ -1,0 +1,83 @@
+"""On-demand g++ build + ctypes loader for the native grid evaluator.
+
+The .so is cached next to the source and rebuilt when fast_oracle.cpp is
+newer.  No pybind11 in this environment: the C ABI boundary is a single
+function over flat buffers, loaded with ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "fast_oracle.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "_fast_oracle.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_error: Optional[str] = None
+
+
+class NativeUnavailable(Exception):
+    """g++ missing or the shared library failed to build/load."""
+
+
+def _build() -> None:
+    # pid-unique temp so concurrent builders can't interleave writes; the
+    # final os.replace is atomic
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        tmp,
+        _SRC,
+        "-pthread",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            raise NativeUnavailable(f"g++ build failed:\n{proc.stderr[-2000:]}")
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if stale) and load the library; raises NativeUnavailable."""
+    global _lib, _error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _error is not None:
+            raise NativeUnavailable(_error)
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(
+                _SRC
+            ):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            fn = lib.cyclonus_evaluate_grid
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            _lib = lib
+            return lib
+        except NativeUnavailable as e:
+            _error = str(e)
+            raise
+        except OSError as e:
+            _error = f"failed to load {_LIB}: {e}"
+            raise NativeUnavailable(_error) from e

@@ -1,0 +1,67 @@
+"""Public entry point for the native grid evaluator."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+from ..matcher.core import Policy
+from ..utils.tracing import phase
+from .bridge import NativeUnsupported, pack_problem
+from .build import NativeUnavailable, load_library
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def evaluate_grid_native(
+    policy: Policy,
+    pods: Sequence[Tuple[str, str, Dict[str, str], str]],
+    namespaces: Dict[str, Dict[str, str]],
+    cases,
+):
+    """Full N x N x Q verdict via the C++ evaluator.  Returns a GridVerdict
+    (numpy-backed).  Raises NativeUnavailable / NativeUnsupported; callers
+    fall back to the Python oracle."""
+    from ..engine.api import GridVerdict
+
+    lib = load_library()
+    with phase("native.pack"):
+        buf = pack_problem(policy, pods, namespaces, cases)
+    n, q = len(pods), len(cases)
+    ingress = np.zeros((q, n, n), dtype=np.uint8)
+    egress = np.zeros((q, n, n), dtype=np.uint8)
+    combined = np.zeros((q, n, n), dtype=np.uint8)
+    pod_keys = [f"{ns}/{name}" for ns, name, _, _ in pods]
+    if q == 0 or n == 0:
+        return GridVerdict(
+            pod_keys,
+            list(cases),
+            ingress.astype(bool),
+            egress.astype(bool),
+            combined.astype(bool),
+        )
+    with phase("native.execute"):
+        rc = lib.cyclonus_evaluate_grid(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(buf.size),
+            ingress.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            egress.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            combined.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    if rc != 0:
+        raise NativeUnsupported(f"native evaluator returned {rc} (layout drift?)")
+    return GridVerdict(
+        pod_keys,
+        list(cases),
+        ingress.astype(bool),
+        egress.astype(bool),
+        combined.astype(bool),
+    )

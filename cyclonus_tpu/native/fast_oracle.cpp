@@ -1,0 +1,353 @@
+// Native grid evaluator: the scalar NetworkPolicy decision procedure
+// (matcher/core.py, reference policy.go:138-174) over the full
+// pod x pod x port-case grid, multithreaded C++.
+//
+// This is the host-side fast path: a third, independent implementation
+// (besides the Python scalar oracle and the JAX/TPU kernel) used both as a
+// fast CPU backend (engine='native') and as a triangulation point for
+// parity fuzzing.  It consumes a flat int32 buffer packed by
+// native/bridge.py; the read order here MUST mirror the write order there.
+//
+// Build: g++ -O3 -shared -fPIC -o _fast_oracle.so fast_oracle.cpp -pthread
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Reader {
+  const int32_t* buf;
+  size_t pos;
+  int32_t scalar() { return buf[pos++]; }
+  const int32_t* arr(size_t n) {
+    const int32_t* p = buf + pos;
+    pos += n;
+    return p;
+  }
+};
+
+// peer kinds (mirrors bridge.py)
+constexpr int32_t PEER_ALL = 0;
+constexpr int32_t PEER_ALL_PORTS = 1;
+constexpr int32_t PEER_IP = 2;
+constexpr int32_t PEER_POD = 3;
+// namespace matcher kinds
+constexpr int32_t NS_EXACT = 0;
+constexpr int32_t NS_SELECTOR = 1;
+constexpr int32_t NS_ALL = 2;
+// pod matcher kinds
+constexpr int32_t POD_ALL = 0;
+constexpr int32_t POD_SELECTOR = 1;
+// selector expression ops
+constexpr int32_t EXP_IN = 0;
+constexpr int32_t EXP_NOT_IN = 1;
+constexpr int32_t EXP_EXISTS = 2;
+constexpr int32_t EXP_DOES_NOT_EXIST = 3;
+// port item kinds
+constexpr int32_t PORT_NIL = 0;
+constexpr int32_t PORT_INT = 1;
+constexpr int32_t PORT_NAMED = 2;
+
+struct Selectors {
+  int32_t S;
+  const int32_t *req_off, *req;
+  const int32_t *exp_off;
+  const int32_t *exp_op, *exp_key, *exp_val_off, *exp_val;
+};
+
+struct Direction {
+  int32_t T, P;
+  const int32_t *tgt_ns, *tgt_sel, *tgt_peer_off;
+  const int32_t *kind, *ns_kind, *ns_exact, *ns_sel, *pod_kind, *pod_sel;
+  const int32_t *ip_base, *ip_mask;
+  const int32_t *ex_off, *ex_base, *ex_mask;
+  const int32_t *port_all;
+  const int32_t *pi_off, *pi_kind, *pi_port, *pi_name, *pi_proto;
+  const int32_t *pr_off, *pr_from, *pr_to, *pr_proto;
+};
+
+bool contains(const int32_t* begin, const int32_t* end, int32_t v) {
+  for (const int32_t* p = begin; p != end; ++p)
+    if (*p == v) return true;
+  return false;
+}
+
+// mirrors kube/labels.py is_labels_match_label_selector
+bool selector_matches(const Selectors& sel, int32_t s, const int32_t* kv,
+                      int32_t nkv, const int32_t* key, int32_t nkey) {
+  for (int32_t r = sel.req_off[s]; r < sel.req_off[s + 1]; ++r)
+    if (!contains(kv, kv + nkv, sel.req[r])) return false;
+  for (int32_t e = sel.exp_off[s]; e < sel.exp_off[s + 1]; ++e) {
+    bool has_key = contains(key, key + nkey, sel.exp_key[e]);
+    bool val_hit = false;
+    for (int32_t v = sel.exp_val_off[e]; v < sel.exp_val_off[e + 1]; ++v)
+      if (contains(kv, kv + nkv, sel.exp_val[v])) {
+        val_hit = true;
+        break;
+      }
+    switch (sel.exp_op[e]) {
+      case EXP_IN:
+        if (!(has_key && val_hit)) return false;
+        break;
+      case EXP_NOT_IN:
+        // NotIn with absent key => no match (labelselector.go:37-49)
+        if (!(has_key && !val_hit)) return false;
+        break;
+      case EXP_EXISTS:
+        if (!has_key) return false;
+        break;
+      case EXP_DOES_NOT_EXIST:
+        if (has_key) return false;
+        break;
+      default:
+        return false;
+    }
+  }
+  return true;
+}
+
+Direction read_direction(Reader& r) {
+  Direction d;
+  d.T = r.scalar();
+  d.P = r.scalar();
+  d.tgt_ns = r.arr(d.T);
+  d.tgt_sel = r.arr(d.T);
+  d.tgt_peer_off = r.arr(d.T + 1);
+  d.kind = r.arr(d.P);
+  d.ns_kind = r.arr(d.P);
+  d.ns_exact = r.arr(d.P);
+  d.ns_sel = r.arr(d.P);
+  d.pod_kind = r.arr(d.P);
+  d.pod_sel = r.arr(d.P);
+  d.ip_base = r.arr(d.P);
+  d.ip_mask = r.arr(d.P);
+  d.ex_off = r.arr(d.P + 1);
+  d.ex_base = r.arr(d.ex_off[d.P]);
+  d.ex_mask = r.arr(d.ex_off[d.P]);
+  d.port_all = r.arr(d.P);
+  d.pi_off = r.arr(d.P + 1);
+  d.pi_kind = r.arr(d.pi_off[d.P]);
+  d.pi_port = r.arr(d.pi_off[d.P]);
+  d.pi_name = r.arr(d.pi_off[d.P]);
+  d.pi_proto = r.arr(d.pi_off[d.P]);
+  d.pr_off = r.arr(d.P + 1);
+  d.pr_from = r.arr(d.pr_off[d.P]);
+  d.pr_to = r.arr(d.pr_off[d.P]);
+  d.pr_proto = r.arr(d.pr_off[d.P]);
+  return d;
+}
+
+void parallel_for(int32_t n, const std::function<void(int32_t, int32_t)>& fn) {
+  unsigned workers = std::thread::hardware_concurrency();
+  if (workers == 0) workers = 4;
+  if ((int32_t)workers > n) workers = n > 0 ? n : 1;
+  std::vector<std::thread> threads;
+  int32_t chunk = (n + workers - 1) / workers;
+  for (unsigned w = 0; w < workers; ++w) {
+    int32_t lo = w * chunk;
+    int32_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back(fn, lo, hi);
+  }
+  for (auto& t : threads) t.join();
+}
+
+}  // namespace
+
+extern "C" int cyclonus_evaluate_grid(const int32_t* buf, int64_t buf_len,
+                                      uint8_t* out_ingress,
+                                      uint8_t* out_egress,
+                                      uint8_t* out_combined) {
+  Reader r{buf, 0};
+  const int32_t N = r.scalar();
+  const int32_t M = r.scalar();
+  const int32_t S = r.scalar();
+  const int32_t Q = r.scalar();
+
+  const int32_t* pod_ns = r.arr(N);
+  const int32_t* pod_ip = r.arr(N);
+  const int32_t* pod_ip_valid = r.arr(N);
+  const int32_t* pod_kv_off = r.arr(N + 1);
+  const int32_t* pod_kv = r.arr(pod_kv_off[N]);
+  const int32_t* pod_key_off = r.arr(N + 1);
+  const int32_t* pod_key = r.arr(pod_key_off[N]);
+  const int32_t* ns_kv_off = r.arr(M + 1);
+  const int32_t* ns_kv = r.arr(ns_kv_off[M]);
+  const int32_t* ns_key_off = r.arr(M + 1);
+  const int32_t* ns_key = r.arr(ns_key_off[M]);
+
+  Selectors sel;
+  sel.S = S;
+  sel.req_off = r.arr(S + 1);
+  sel.req = r.arr(sel.req_off[S]);
+  sel.exp_off = r.arr(S + 1);
+  const int32_t E = sel.exp_off[S];
+  sel.exp_op = r.arr(E);
+  sel.exp_key = r.arr(E);
+  sel.exp_val_off = r.arr(E + 1);
+  sel.exp_val = r.arr(sel.exp_val_off[E]);
+
+  const int32_t* q_port = r.arr(Q);
+  const int32_t* q_name = r.arr(Q);
+  const int32_t* q_proto = r.arr(Q);
+
+  Direction dirs[2] = {read_direction(r), read_direction(r)};  // ingress, egress
+  if ((int64_t)r.pos != buf_len) return 1;  // layout drift guard
+
+  // --- selector-vs-pod and selector-vs-namespace tables ---
+  std::vector<uint8_t> selpod((size_t)S * N), selns((size_t)S * M);
+  parallel_for(S, [&](int32_t lo, int32_t hi) {
+    for (int32_t s = lo; s < hi; ++s) {
+      for (int32_t n = 0; n < N; ++n)
+        selpod[(size_t)s * N + n] = selector_matches(
+            sel, s, pod_kv + pod_kv_off[n], pod_kv_off[n + 1] - pod_kv_off[n],
+            pod_key + pod_key_off[n], pod_key_off[n + 1] - pod_key_off[n]);
+      for (int32_t m = 0; m < M; ++m)
+        selns[(size_t)s * M + m] = selector_matches(
+            sel, s, ns_kv + ns_kv_off[m], ns_kv_off[m + 1] - ns_kv_off[m],
+            ns_key + ns_key_off[m], ns_key_off[m + 1] - ns_key_off[m]);
+    }
+  });
+
+  for (int di = 0; di < 2; ++di) {
+    const Direction& d = dirs[di];
+    const bool is_ingress = (di == 0);
+
+    // tmatch[T][N], has_target[N]
+    std::vector<uint8_t> tmatch((size_t)d.T * N), has_target(N, 0);
+    for (int32_t t = 0; t < d.T; ++t)
+      for (int32_t n = 0; n < N; ++n) {
+        uint8_t m = (d.tgt_ns[t] == pod_ns[n]) &&
+                    selpod[(size_t)d.tgt_sel[t] * N + n];
+        tmatch[(size_t)t * N + n] = m;
+        if (m) has_target[n] = 1;
+      }
+
+    // peer_match[P][N] (ports aside)
+    std::vector<uint8_t> peer_match((size_t)d.P * N);
+    parallel_for(d.P, [&](int32_t lo, int32_t hi) {
+      for (int32_t p = lo; p < hi; ++p)
+        for (int32_t n = 0; n < N; ++n) {
+          bool ok;
+          switch (d.kind[p]) {
+            case PEER_ALL:
+            case PEER_ALL_PORTS:
+              ok = true;
+              break;
+            case PEER_IP: {
+              uint32_t ip = (uint32_t)pod_ip[n];
+              ok = pod_ip_valid[n] &&
+                   ((ip & (uint32_t)d.ip_mask[p]) == (uint32_t)d.ip_base[p]);
+              if (ok)
+                for (int32_t e = d.ex_off[p]; e < d.ex_off[p + 1]; ++e)
+                  if ((ip & (uint32_t)d.ex_mask[e]) == (uint32_t)d.ex_base[e]) {
+                    ok = false;
+                    break;
+                  }
+              break;
+            }
+            case PEER_POD: {
+              bool ns_ok;
+              switch (d.ns_kind[p]) {
+                case NS_EXACT:
+                  ns_ok = d.ns_exact[p] == pod_ns[n];
+                  break;
+                case NS_SELECTOR:
+                  ns_ok = selns[(size_t)d.ns_sel[p] * M + pod_ns[n]];
+                  break;
+                default:
+                  ns_ok = true;
+              }
+              bool pod_ok = d.pod_kind[p] == POD_ALL ||
+                            selpod[(size_t)d.pod_sel[p] * N + n];
+              ok = ns_ok && pod_ok;
+              break;
+            }
+            default:
+              ok = false;
+          }
+          peer_match[(size_t)p * N + n] = ok;
+        }
+    });
+
+    // pport[P][Q]
+    std::vector<uint8_t> pport((size_t)d.P * Q);
+    for (int32_t p = 0; p < d.P; ++p)
+      for (int32_t q = 0; q < Q; ++q) {
+        bool ok = d.port_all[p];
+        for (int32_t i = d.pi_off[p]; !ok && i < d.pi_off[p + 1]; ++i) {
+          bool proto_ok = d.pi_proto[i] == q_proto[q];
+          switch (d.pi_kind[i]) {
+            case PORT_NIL:
+              ok = proto_ok;
+              break;
+            case PORT_INT:
+              ok = proto_ok && d.pi_port[i] == q_port[q];
+              break;
+            case PORT_NAMED:
+              ok = proto_ok && q_name[q] >= 0 && d.pi_name[i] == q_name[q];
+              break;
+          }
+        }
+        for (int32_t i = d.pr_off[p]; !ok && i < d.pr_off[p + 1]; ++i)
+          ok = d.pr_from[i] <= q_port[q] && q_port[q] <= d.pr_to[i] &&
+               d.pr_proto[i] == q_proto[q];
+        pport[(size_t)p * Q + q] = ok;
+      }
+
+    // tallow[T][N][Q]: any peer of target t allows (peer pod n, case q)
+    std::vector<uint8_t> tallow((size_t)d.T * N * Q, 0);
+    parallel_for(d.T, [&](int32_t lo, int32_t hi) {
+      for (int32_t t = lo; t < hi; ++t)
+        for (int32_t pi = d.tgt_peer_off[t]; pi < d.tgt_peer_off[t + 1]; ++pi)
+          for (int32_t n = 0; n < N; ++n) {
+            if (!peer_match[(size_t)pi * N + n]) continue;
+            uint8_t* row = &tallow[((size_t)t * N + n) * Q];
+            for (int32_t q = 0; q < Q; ++q)
+              row[q] |= pport[(size_t)pi * Q + q];
+          }
+    });
+
+    // verdict rows: for each target-side pod a, peer-side pod b, case q
+    uint8_t* out = is_ingress ? out_ingress : out_egress;
+    parallel_for(N, [&](int32_t lo, int32_t hi) {
+      std::vector<int32_t> my_targets;
+      for (int32_t a = lo; a < hi; ++a) {
+        my_targets.clear();
+        for (int32_t t = 0; t < d.T; ++t)
+          if (tmatch[(size_t)t * N + a]) my_targets.push_back(t);
+        for (int32_t b = 0; b < N; ++b)
+          for (int32_t q = 0; q < Q; ++q) {
+            uint8_t allowed;
+            if (my_targets.empty()) {
+              allowed = 1;  // no matching target => allow (policy.go:158-160)
+            } else {
+              allowed = 0;
+              for (int32_t t : my_targets)
+                if (tallow[((size_t)t * N + b) * Q + q]) {
+                  allowed = 1;
+                  break;
+                }
+            }
+            // ingress rows are indexed [q][dst=a][src=b]; egress
+            // [q][src=a][dst=b]
+            out[(size_t)q * N * N + (size_t)a * N + b] = allowed;
+          }
+      }
+    });
+  }
+
+  // combined[q][s][d] = egress[q][s][d] AND ingress[q][d][s]
+  parallel_for(N, [&](int32_t lo, int32_t hi) {
+    for (int32_t s = lo; s < hi; ++s)
+      for (int32_t q = 0; q < Q; ++q)
+        for (int32_t dd = 0; dd < N; ++dd)
+          out_combined[(size_t)q * N * N + (size_t)s * N + dd] =
+              out_egress[(size_t)q * N * N + (size_t)s * N + dd] &
+              out_ingress[(size_t)q * N * N + (size_t)dd * N + s];
+  });
+  return 0;
+}

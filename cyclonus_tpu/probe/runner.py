@@ -86,10 +86,12 @@ class Runner:
 
 class SimulatedJobRunner(JobRunner):
     """engine='oracle': per-job scalar evaluation (reference behavior).
-    engine='tpu': grid evaluation on device, optionally mesh-sharded."""
+    engine='tpu': grid evaluation on device, optionally mesh-sharded.
+    engine='native': C++ grid evaluation on host (falls back to oracle
+    when the native library is unavailable or the shape unsupported)."""
 
     def __init__(self, policies: Policy, engine: str = DEFAULT_ENGINE, sharded: bool = False):
-        if engine not in ("oracle", "tpu"):
+        if engine not in ("oracle", "tpu", "native"):
             raise ValueError(f"invalid simulated engine {engine!r}")
         self.policies = policies
         self.engine = engine
@@ -122,14 +124,11 @@ class SimulatedJobRunner(JobRunner):
     ) -> List[JobResult]:
         if self.engine == "oracle" or resources is None or not jobs:
             return self.run_jobs(jobs)
-        from ..engine import PortCase, TpuPolicyEngine
+        from ..engine import PortCase
 
         pods = [
             (p.namespace, p.name, p.labels, p.ip) for p in resources.pods
         ]
-        engine = TpuPolicyEngine(self.policies, pods, resources.namespaces)
-        pod_index = engine.pod_index()
-
         cases: List[PortCase] = []
         case_index: Dict[PortCase, int] = {}
         for job in jobs:
@@ -137,10 +136,30 @@ class SimulatedJobRunner(JobRunner):
             if case not in case_index:
                 case_index[case] = len(cases)
                 cases.append(case)
-        if self.sharded:
-            grid = engine.evaluate_grid_sharded(cases)
+
+        if self.engine == "native":
+            from ..native import (
+                NativeUnavailable,
+                NativeUnsupported,
+                evaluate_grid_native,
+            )
+
+            try:
+                grid = evaluate_grid_native(
+                    self.policies, pods, resources.namespaces, cases
+                )
+            except (NativeUnavailable, NativeUnsupported):
+                return self.run_jobs(jobs)
+            pod_index = {k: i for i, k in enumerate(grid.pod_keys)}
         else:
-            grid = engine.evaluate_grid(cases)
+            from ..engine import TpuPolicyEngine
+
+            engine = TpuPolicyEngine(self.policies, pods, resources.namespaces)
+            pod_index = engine.pod_index()
+            if self.sharded:
+                grid = engine.evaluate_grid_sharded(cases)
+            else:
+                grid = engine.evaluate_grid(cases)
 
         results = []
         for job in jobs:

@@ -68,11 +68,11 @@ class Recipe:
             out.extend(load_policies_from_yaml(y))
         return out
 
-    def run_probe(self, engine: str = DEFAULT_ENGINE) -> Table:
-        """recipe.go:33-36."""
-        runner = new_simulated_runner(
-            build_network_policies(True, self.policies()), engine=engine
-        )
+    def run_probe(self, engine: str = DEFAULT_ENGINE, policy=None) -> Table:
+        """recipe.go:33-36.  `policy` reuses an already-built matcher set."""
+        if policy is None:
+            policy = build_network_policies(True, self.policies())
+        runner = new_simulated_runner(policy, engine=engine)
         return runner.run_probe_for_config(
             ProbeConfig.port_protocol_config(IntOrString(self.port), self.protocol),
             self.resources,
@@ -488,8 +488,8 @@ def run_all_recipes(engine: str = DEFAULT_ENGINE, out=None) -> None:
 
     out = out or sys.stdout
     for recipe in ALL_RECIPES:
-        table = recipe.run_probe(engine=engine)
         policy = build_network_policies(True, recipe.policies())
+        table = recipe.run_probe(engine=engine, policy=policy)
         out.write(f"=== recipe {recipe.name} ===\n")
         out.write(f"Policies:\n{explain_table(policy)}\n")
         out.write(f"Resources:\n{recipe.resources.render_table()}\n")

@@ -47,6 +47,17 @@ def test_journal_roundtrip(tmp_path):
     assert by_desc["case two"]["error"] == "boom"
 
 
+def test_journal_resume_reruns_errored_cases(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path)
+    j.record("clean", passed=True, step_count=1, key="0:clean")
+    j.record("flaked", passed=False, step_count=1, error="kube timeout", key="1:flaked")
+    j2 = Journal(path)
+    assert j2.should_skip("0:clean")
+    assert not j2.should_skip("1:flaked")  # errored => re-run on resume
+    assert not j2.should_skip("2:never-ran")
+
+
 def test_journal_tolerates_torn_write(tmp_path):
     path = str(tmp_path / "journal.jsonl")
     j = Journal(path)
