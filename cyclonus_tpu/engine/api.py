@@ -233,8 +233,6 @@ class TpuPolicyEngine:
     def evaluate_grid(self, cases: Sequence[PortCase]) -> GridVerdict:
         """Single-device evaluation of the full N x N x Q verdict grid.
         Results stay on device (see GridVerdict)."""
-        import jax
-
         from .kernel import evaluate_grid_kernel
 
         self._check_ips()
@@ -242,14 +240,7 @@ class TpuPolicyEngine:
             n = self.encoding.cluster.n_pods
             empty = np.zeros((0, n, n), dtype=bool)
             return GridVerdict(self.pod_keys, [], empty, empty.copy(), empty.copy())
-        q_port, q_name, q_proto = self._port_case_arrays(cases)
-        if self._device_tensors is None:
-            with phase("engine.device_put"):
-                self._device_tensors = jax.device_put(self._tensors)
-        tensors = dict(self._device_tensors)
-        tensors["q_port"] = q_port
-        tensors["q_name"] = q_name
-        tensors["q_proto"] = q_proto
+        tensors = self._tensors_with_cases(cases, device=True)
         # dispatch-only timing: jit calls return once enqueued (async);
         # device execution time lands in grid.fetch / allow_stats
         with phase("engine.dispatch"):
@@ -261,6 +252,79 @@ class TpuPolicyEngine:
             out["ingress"],
             out["egress"],
             out["combined"],
+        )
+
+    def _tensors_with_cases(
+        self, cases: Sequence[PortCase], device: bool = False
+    ) -> Dict:
+        """Tensors + port-case arrays.  device=True reuses the device_put
+        cache (paths that don't re-pad the pod axis host-side)."""
+        q_port, q_name, q_proto = self._port_case_arrays(cases)
+        if device:
+            import jax
+
+            if self._device_tensors is None:
+                with phase("engine.device_put"):
+                    self._device_tensors = jax.device_put(self._tensors)
+            tensors = dict(self._device_tensors)
+        else:
+            tensors = dict(self._tensors)
+        tensors["q_port"] = q_port
+        tensors["q_name"] = q_name
+        tensors["q_proto"] = q_proto
+        return tensors
+
+    def evaluate_grid_counts(
+        self, cases: Sequence[PortCase], block: int = 1024
+    ) -> Dict[str, int]:
+        """Tiled full-grid allow counts for grids too large to materialize
+        (one device execution, one small readback — see engine/tiled.py)."""
+        from .tiled import evaluate_grid_counts
+
+        self._check_ips()
+        n = self.encoding.cluster.n_pods
+        if not cases or n == 0:
+            return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        return evaluate_grid_counts(
+            self._tensors_with_cases(cases), n, block=block
+        )
+
+    def iter_grid_blocks(self, cases: Sequence[PortCase], block: int = 1024):
+        """Stream verdict blocks of source rows to the host:
+        yields (start, ingress_rows, egress, combined), arrays [b, N, Q]
+        bool.  For consumers that scan grids bigger than host/device
+        memory."""
+        from .tiled import iter_grid_blocks
+
+        self._check_ips()
+        n = self.encoding.cluster.n_pods
+        if not cases or n == 0:
+            return iter(())
+        return iter_grid_blocks(self._tensors_with_cases(cases), n, block=block)
+
+    def evaluate_pairs(
+        self, cases: Sequence[PortCase], pairs: Sequence[Tuple[int, int]]
+    ) -> np.ndarray:
+        """Point verdicts for (src_idx, dst_idx) pod pairs: [K, Q, 3] bool
+        (ingress, egress, combined) — no N x N grid anywhere, so it scales
+        to arbitrary cluster sizes (powers the large-scale parity spot
+        checks in bench.py)."""
+        from .tiled import evaluate_pairs_kernel
+
+        self._check_ips()
+        if not cases or len(pairs) == 0:
+            return np.zeros((len(pairs), len(cases), 3), dtype=bool)
+        idx = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
+        out = evaluate_pairs_kernel(
+            self._tensors_with_cases(cases, device=True), idx[:, 0], idx[:, 1]
+        )
+        return np.stack(
+            [
+                np.asarray(out["ingress"]),
+                np.asarray(out["egress"]),
+                np.asarray(out["combined"]),
+            ],
+            axis=2,
         )
 
     def evaluate_grid_sharded(
@@ -276,11 +340,7 @@ class TpuPolicyEngine:
         self._check_ips()
         if not cases:
             return self.evaluate_grid(cases)
-        q_port, q_name, q_proto = self._port_case_arrays(cases)
-        tensors = dict(self._tensors)
-        tensors["q_port"] = q_port
-        tensors["q_name"] = q_name
-        tensors["q_proto"] = q_proto
+        tensors = self._tensors_with_cases(cases)
         import jax.numpy as jnp
 
         with phase("engine.dispatch_sharded"):

@@ -41,7 +41,12 @@ def _build() -> None:
         "-pthread",
     ]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except subprocess.TimeoutExpired as e:
+            raise NativeUnavailable(f"g++ build timed out: {e}") from e
         if proc.returncode != 0:
             raise NativeUnavailable(f"g++ build failed:\n{proc.stderr[-2000:]}")
         os.replace(tmp, _LIB)

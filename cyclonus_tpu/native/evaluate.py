@@ -61,7 +61,9 @@ def evaluate_grid_native(
     return GridVerdict(
         pod_keys,
         list(cases),
-        ingress.astype(bool),
-        egress.astype(bool),
-        combined.astype(bool),
+        # the evaluator writes only 0/1, so a bool view is a free
+        # reinterpretation (astype would copy all three N*N*Q grids)
+        ingress.view(bool),
+        egress.view(bool),
+        combined.view(bool),
     )

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Demo of the analysis CLI against the bundled fixtures (no cluster needed).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run() { echo "+ $*"; python -m cyclonus_tpu "$@"; echo; }
+
+run analyze --mode parse --mode explain --mode lint \
+  --policy-path examples/networkpolicies/simple-example
+
+run analyze --mode query-target \
+  --policy-path examples/networkpolicies/simple-example \
+  --target-pod-path examples/targets.json
+
+run analyze --mode query-traffic \
+  --policy-path examples/networkpolicies/simple-example \
+  --traffic-path examples/traffic.json
+
+run analyze --mode probe --engine tpu \
+  --policy-path examples/networkpolicies/simple-example \
+  --probe-path examples/probe.json
+
+run generate --mock --dry-run
+
+run recipes

@@ -202,11 +202,11 @@ class TestCLI:
         proc = run_cli(
             "analyze",
             "--policy-path",
-            "/root/reference/networkpolicies/simple-example",
+            "examples/networkpolicies/simple-example",
             "--mode",
             "probe",
             "--probe-path",
-            "/root/reference/examples/probe.json",
+            "examples/probe.json",
         )
         assert proc.returncode == 0, proc.stderr
         assert "Combined:" in proc.stdout
@@ -243,7 +243,7 @@ class TestCLI:
             "--probe-protocol",
             "tcp",
             "--policy-path",
-            "/root/reference/networkpolicies/simple-example",
+            "examples/networkpolicies/simple-example",
             timeout=600,
         )
         assert proc.returncode == 0, proc.stderr

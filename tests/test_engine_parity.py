@@ -7,7 +7,9 @@ randomized policy/cluster fuzzer.  Both the single-device kernel and the
 8-virtual-device sharded path are checked.
 """
 
+import os
 import random
+from pathlib import Path
 
 import pytest
 
@@ -112,18 +114,33 @@ CASES_MULTI = [
 ]
 
 
+REFERENCE = "/root/reference/networkpolicies/simple-example"
+BUNDLED = str(Path(__file__).resolve().parents[1] / "examples/networkpolicies/simple-example")
+requires_reference = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE), reason="reference checkout not present"
+)
+
+
 class TestSimpleExampleParity:
+    def test_bundled_fixture(self):
+        pols = load_policies_from_path(BUNDLED)
+        policy = build_network_policies(True, pols)
+        pods, namespaces = default_cluster()
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    @requires_reference
     def test_reference_fixture(self):
         pols = load_policies_from_path(
-            "/root/reference/networkpolicies/simple-example"
+            REFERENCE
         )
         policy = build_network_policies(True, pols)
         pods, namespaces = default_cluster()
         assert_parity(policy, pods, namespaces, CASES_MULTI)
 
+    @requires_reference
     def test_reference_fixture_sharded(self):
         pols = load_policies_from_path(
-            "/root/reference/networkpolicies/simple-example"
+            REFERENCE
         )
         policy = build_network_policies(True, pols)
         pods, namespaces = default_cluster()

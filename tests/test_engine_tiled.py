@@ -1,0 +1,171 @@
+"""Parity gate for the tiled/streaming evaluation paths (engine/tiled.py):
+counts, streamed blocks, and point-pair verdicts must agree exactly with
+the single-device kernel (itself oracle-checked by test_engine_parity.py),
+across fuzzed policy sets, odd block sizes (pad rows in play), and the
+IPv6 host-fallback path.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
+from cyclonus_tpu.matcher import build_network_policies
+
+from test_engine_parity import (
+    default_cluster,
+    mkpol,
+    oracle_grid,
+    random_policy,
+)
+from cyclonus_tpu.kube.netpol import (
+    IPBlock,
+    LabelSelector,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+)
+
+CASES = [
+    PortCase(80, "serve-80-tcp", "TCP"),
+    PortCase(81, "serve-81-udp", "UDP"),
+]
+
+
+def fuzz_problem(seed, n_extra_pods=0):
+    rng = random.Random(seed)
+    nss = ["x", "y", "z"]
+    keys = ["pod", "app", "ns", "team"]
+    values = ["a", "b", "c", "x", "y", "z", "blue", "red"]
+    namespaces = {
+        ns: {"ns": ns, "team": rng.choice(["blue", "red"])} for ns in nss
+    }
+    pods, namespaces_d = default_cluster()
+    namespaces.update(namespaces_d)
+    for i in range(n_extra_pods):
+        ns = rng.choice(nss)
+        pods.append(
+            (ns, f"extra-{i}", {"app": rng.choice(values)}, f"192.168.2.{i + 1}")
+        )
+    policies = [
+        random_policy(rng, i, nss, keys, values)
+        for i in range(rng.randrange(2, 6))
+    ]
+    return build_network_policies(True, policies), pods, namespaces
+
+
+def full_grids(engine, cases):
+    g = engine.evaluate_grid(cases)
+    return (
+        np.asarray(g.ingress),
+        np.asarray(g.egress),
+        np.asarray(g.combined),
+    )
+
+
+class TestTiledCounts:
+    @pytest.mark.parametrize("seed,block", [(0, 4), (1, 5), (2, 16), (3, 64)])
+    def test_counts_match_kernel(self, seed, block):
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=7)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        counts = engine.evaluate_grid_counts(CASES, block=block)
+        assert counts["ingress"] == int(ing.sum())
+        assert counts["egress"] == int(egr.sum())
+        assert counts["combined"] == int(comb.sum())
+        assert counts["cells"] == ing.size
+
+    def test_counts_empty(self):
+        policy, pods, namespaces = fuzz_problem(0)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        assert engine.evaluate_grid_counts([]) == {
+            "ingress": 0,
+            "egress": 0,
+            "combined": 0,
+            "cells": 0,
+        }
+
+
+class TestTiledBlocks:
+    @pytest.mark.parametrize("seed,block", [(4, 4), (5, 7), (6, 32)])
+    def test_blocks_match_kernel(self, seed, block):
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=5)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)  # [Q, N, N]
+        n = len(pods)
+        seen = 0
+        for start, b_ing, b_egr, b_comb in engine.iter_grid_blocks(
+            CASES, block=block
+        ):
+            b = b_egr.shape[0]
+            # block layout: [b, N, Q]; full-grid: ingress [Q, dst, src],
+            # egress/combined [Q, src, dst]
+            np.testing.assert_array_equal(
+                b_egr, np.moveaxis(egr[:, start : start + b, :], 0, -1)
+            )
+            np.testing.assert_array_equal(
+                b_comb, np.moveaxis(comb[:, start : start + b, :], 0, -1)
+            )
+            np.testing.assert_array_equal(
+                b_ing,
+                np.moveaxis(ing[:, :, start : start + b], 0, -1).transpose(
+                    1, 0, 2
+                ),
+            )
+            seen += b
+        assert seen == n
+
+
+class TestPairs:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_pairs_match_oracle(self, seed):
+        policy, pods, namespaces = fuzz_problem(100 + seed, n_extra_pods=3)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        rng = random.Random(seed)
+        n = len(pods)
+        pairs = [(rng.randrange(n), rng.randrange(n)) for _ in range(40)]
+        got = engine.evaluate_pairs(CASES, pairs)  # [K, Q, 3]
+        expected = oracle_grid(policy, pods, namespaces, CASES)
+        for k, (s, d) in enumerate(pairs):
+            for qi in range(len(CASES)):
+                exp = expected[(qi, s, d)]
+                assert tuple(bool(x) for x in got[k, qi]) == exp, (
+                    f"pair ({s},{d}) case {qi}: engine="
+                    f"{tuple(got[k, qi])} oracle={exp}"
+                )
+
+    def test_pairs_ipv6_host_fallback(self):
+        # IPv6 IPBlock forces host-evaluated peer rows; the pairs kernel
+        # must re-index them by original pod row
+        pods, namespaces = default_cluster()
+        pods = [
+            (ns, name, labels, ip if i % 2 else f"2001:db8::{i + 1}")
+            for i, (ns, name, labels, ip) in enumerate(pods)
+        ]
+        pol = mkpol(
+            "v6",
+            "x",
+            LabelSelector.make(),
+            ["Ingress"],
+            ingress=[
+                NetworkPolicyIngressRule(
+                    ports=[],
+                    from_=[
+                        NetworkPolicyPeer(
+                            ip_block=IPBlock.make("2001:db8::/112", [])
+                        )
+                    ],
+                )
+            ],
+        )
+        policy = build_network_policies(True, [pol])
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        n = len(pods)
+        pairs = [(s, d) for s in range(n) for d in range(n)]
+        got = engine.evaluate_pairs(CASES, pairs)
+        for k, (s, d) in enumerate(pairs):
+            for qi in range(len(CASES)):
+                assert bool(got[k, qi, 0]) == bool(ing[qi, d, s])
+                assert bool(got[k, qi, 1]) == bool(egr[qi, s, d])
+                assert bool(got[k, qi, 2]) == bool(comb[qi, s, d])
