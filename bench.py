@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Benchmark: simulated connectivity cells/sec on a synthetic service-mesh
-cluster (BASELINE.md config 3 by default: 10k pods x 1k policies, dense
-label matching).
+cluster.  Default = the BASELINE.md north-star: 100k pods x 10k policies,
+full 2e10-cell matrix, tiled fused-pallas path, single chip.
 
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "cells/sec", "vs_baseline": N}
@@ -13,10 +13,11 @@ The reference publishes no numbers (BASELINE.md); its simulated engine is a
 sequential Go loop (jobrunner.go:68-74).  A scalar-oracle spot check on a
 random sample of cells guards against benchmarking a wrong kernel.
 
-Env overrides: BENCH_PODS, BENCH_POLICIES, BENCH_SHARDED=1 (mesh over all
-visible devices), BENCH_SAMPLE (oracle spot-check size), BENCH_TILED=1
-(tiled counts mode: one device-side block loop, scales past HBM —
-engine/tiled.py), BENCH_BLOCK (tile height, default 1024).
+Env overrides: BENCH_PODS, BENCH_POLICIES, BENCH_SAMPLE (oracle spot-check
+size), BENCH_TILED (default 1: tiled counts mode, scales past HBM;
+0 = full-grid tables mode, needs BENCH_PODS <~ 25000 on one chip),
+BENCH_COUNTS_BACKEND (pallas | xla), BENCH_BLOCK (xla tile height),
+BENCH_SHARDED=1 (full-grid mode over a device mesh).
 """
 
 import json
@@ -179,12 +180,18 @@ def spot_check_pairs(engine, policy, pods, namespaces, cases, n_samples, rng):
 
 
 def main():
-    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
-    n_policies = int(os.environ.get("BENCH_POLICIES", "1000"))
+    # default = the BASELINE.md north-star configuration (100k pods x 10k
+    # policies, full matrix), measured on the tiled fused-pallas path —
+    # the only mode that fits a single chip at this scale
+    n_pods = int(os.environ.get("BENCH_PODS", "100000"))
+    n_policies = int(os.environ.get("BENCH_POLICIES", "10000"))
     sharded = os.environ.get("BENCH_SHARDED", "") == "1"
-    tiled = os.environ.get("BENCH_TILED", "") == "1"
+    # BENCH_SHARDED selects the full-grid mesh path, which the tiled
+    # default would otherwise shadow
+    tiled = os.environ.get("BENCH_TILED", "1") == "1" and not sharded
+    counts_backend = os.environ.get("BENCH_COUNTS_BACKEND", "pallas")
     block = int(os.environ.get("BENCH_BLOCK", "1024"))
-    n_samples = int(os.environ.get("BENCH_SAMPLE", "150"))
+    n_samples = int(os.environ.get("BENCH_SAMPLE", "25"))
     rng = random.Random(20260729)
 
     from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
@@ -205,7 +212,9 @@ def main():
         # counts mode: the whole tile loop runs device-side in one jit; the
         # [n_tiles, 3] readback is the execution barrier
         def run_tiled():
-            return engine.evaluate_grid_counts(cases, block=block)
+            return engine.evaluate_grid_counts(
+                cases, block=block, backend=counts_backend
+            )
 
         t0 = time.time()
         counts = run_tiled()
@@ -228,7 +237,9 @@ def main():
         sub_n = min(n_pods, 384)
         sub_pods = [pods[i] for i in sorted(rng.sample(range(n_pods), sub_n))]
         sub_engine = TpuPolicyEngine(policy, sub_pods, namespaces)
-        sub_counts = sub_engine.evaluate_grid_counts(cases, block=100)
+        sub_counts = sub_engine.evaluate_grid_counts(
+            cases, block=100, backend=counts_backend
+        )
         sub_grid = sub_engine.evaluate_grid(cases)
         expected = {
             "ingress": int(np.asarray(sub_grid.ingress).sum()),
@@ -247,7 +258,7 @@ def main():
                 {
                     "metric": f"simulated connectivity cells/sec ({n_pods} pods"
                     f" x {n_policies} policies, {len(cases)} port cases, "
-                    f"tiled block={block})",
+                    f"tiled {counts_backend})",
                     "value": round(cells_per_sec),
                     "unit": "cells/sec",
                     "vs_baseline": round(

@@ -275,16 +275,30 @@ class TpuPolicyEngine:
         return tensors
 
     def evaluate_grid_counts(
-        self, cases: Sequence[PortCase], block: int = 1024
+        self,
+        cases: Sequence[PortCase],
+        block: int = 1024,
+        backend: str = "xla",
     ) -> Dict[str, int]:
         """Tiled full-grid allow counts for grids too large to materialize
-        (one device execution, one small readback — see engine/tiled.py)."""
-        from .tiled import evaluate_grid_counts
-
+        (one device execution, one small readback).  backend="xla" runs
+        the lax.fori_loop tile loop (engine/tiled.py); backend="pallas"
+        runs the fused verdict+count Pallas kernel (engine/pallas_kernel.py,
+        interpret mode off-TPU) — identical results by construction."""
         self._check_ips()
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        if backend == "pallas":
+            from .pallas_kernel import evaluate_grid_counts_pallas
+
+            # no host-side padding here, so the device_put cache applies
+            return evaluate_grid_counts_pallas(
+                self._tensors_with_cases(cases, device=True), n
+            )
+        from .tiled import evaluate_grid_counts
+
+        # the xla path pads the pod axis with numpy before dispatch
         return evaluate_grid_counts(
             self._tensors_with_cases(cases), n, block=block
         )

@@ -1,0 +1,260 @@
+"""Pallas TPU kernel: fused verdict-tile + count reduction.
+
+The XLA tiled counts path (tiled.py) materializes per-tile boolean verdict
+blocks and f32 matmul outputs in HBM before reducing them.  This kernel
+fuses the whole per-tile epilogue —
+
+    egress   = (tmatch_e_blk^T @ tallow_e) > 0  OR  no-egress-target
+    ingress  = (tallow_i_blk^T @ tmatch_i) > 0  OR  no-ingress-target
+    combined = egress AND ingress
+    counts  += [sum ingress, sum egress, sum combined]  (validity-masked)
+
+— into VMEM: a blocked matmul over grid (q, src-tile, dst-tile, T-chunk)
+with two f32 accumulators in scratch and a count epilogue on the last
+T-chunk.  The three N x N x Q verdict tensors never exist anywhere.
+
+Decision procedure mirrors tiled._tile_verdicts / kernel.py (reference
+policy.go:138-174); parity vs the XLA paths is enforced by
+tests/test_engine_pallas.py (interpret mode on CPU, compiled on TPU).
+
+Layout notes:
+  * all matmul operands are pre-cast to bf16; accumulation is f32 on the
+    MXU, so the > 0 threshold is exact (0/1 inputs).
+  * the pod axis is padded to the lane-aligned tile BD and the target
+    axis to the chunk KT with zeros: padded targets match nothing and
+    allow nothing; padded pods carry valid=0 and are masked out of the
+    counts in the epilogue.
+  * counts accumulate into a per-(port case, src-tile) int32 output block
+    (the standard reduction-output pattern); lanes 0-2 hold ingress/
+    egress/combined.  Per-block partials are bounded by BS * N, so they
+    cannot overflow int32 below ~4M pods; the host sums them in int64
+    (a single global int32 accumulator overflowed at 100k pods).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# tile sizes: BS/BD are the src/dst tile heights (MXU-aligned), KT is the
+# target-axis chunk.  VMEM at these sizes: 4 input blocks x 1MB, double
+# buffered, + 2MB scratch ~= 10MB of the ~16MB budget.
+BS = 512
+BD = 512
+KT = 1024
+
+
+def _verdict_counts_kernel(
+    a_e_ref,  # [BS, KT] bf16   tmatch_e^T src block, T-chunk k
+    b_e_ref,  # [1, KT, BD] bf16  tallow_e (q, T-chunk k, dst block j)
+    b_i_ref,  # [1, KT, BS] bf16  tallow_i (q, T-chunk k, src block i)
+    a_i_ref,  # [KT, BD] bf16   tmatch_i (T-chunk k, dst block j)
+    has_e_ref,  # [1, BS] int32  src block i
+    has_i_ref,  # [1, BD] int32  dst block j
+    valid_s_ref,  # [1, BS] int32
+    valid_d_ref,  # [1, BD] int32
+    counts_ref,  # [1, n_i, 128] int32: per-q count plane, row per src-tile
+    acc_e_ref,  # [BS, BD] f32 scratch
+    acc_i_ref,  # [BS, BD] f32 scratch
+    cnt_ref,  # [1, 128] int32 scratch: running counts for this (q, i)
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    k = pl.program_id(3)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+
+    # counts accumulate into a per-(q, src-tile) ROW of the per-q count
+    # plane: a single global accumulator overflows int32 once allowed
+    # cells exceed 2^31 (seen at 100k pods); per-row partials are bounded
+    # by BS * N < 2^31.  (The plane is the output block — a (1, 1, 128)
+    # block would violate the Mosaic (8, 128) tiling rule for n_i > 1.)
+    @pl.when((i == 0) & (j == 0) & (k == 0))
+    def _init_counts():
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    @pl.when(k == 0)
+    def _init_acc():
+        acc_e_ref[:] = jnp.zeros_like(acc_e_ref)
+        acc_i_ref[:] = jnp.zeros_like(acc_i_ref)
+
+    @pl.when((j == 0) & (k == 0))
+    def _init_cnt():
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+    # egress[b, d] += sum_t tmatch_e[t, src b] * tallow_e[t, dst d]
+    acc_e_ref[:] += jnp.dot(
+        a_e_ref[:], b_e_ref[0], preferred_element_type=jnp.float32
+    )
+    # ingress[b, d] += sum_t tallow_i[t, src b] * tmatch_i[t, dst d]
+    acc_i_ref[:] += jax.lax.dot_general(
+        b_i_ref[0],
+        a_i_ref[:],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == n_k - 1)
+    def _epilogue():
+        # Mosaic can't reshape i1 vectors to 2D — route every row-direction
+        # broadcast through f32.  acc values are nonneg counts, so adding a
+        # huge constant where the pod has no target flips the > 0 verdict.
+        no_e = (has_e_ref[0, :] == 0).astype(jnp.float32)[:, None]  # [BS, 1]
+        no_i = (has_i_ref[0, :] == 0).astype(jnp.float32)  # [BD]
+        egress = (acc_e_ref[:] + no_e * 1e9) > 0.0
+        ingress = (acc_i_ref[:] + no_i[None, :] * 1e9) > 0.0
+        combined = egress & ingress
+        vs = valid_s_ref[0, :].astype(jnp.float32)[:, None]  # [BS, 1]
+        vd = valid_d_ref[0, :].astype(jnp.float32)  # [BD]
+        mask = (vs * vd[None, :]) > 0.0
+        c_in = jnp.sum((ingress & mask).astype(jnp.int32))
+        c_eg = jnp.sum((egress & mask).astype(jnp.int32))
+        c_co = jnp.sum((combined & mask).astype(jnp.int32))
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        cnt_ref[:] += (
+            jnp.where(lane == 0, c_in, 0)
+            + jnp.where(lane == 1, c_eg, 0)
+            + jnp.where(lane == 2, c_co, 0)
+        )
+        # flush to this (q, i)'s row of the count plane once per src-tile
+        # (the dynamic-row store is the expensive part)
+        @pl.when(j == n_j - 1)
+        def _flush():
+            counts_ref[:, pl.ds(i, 1), :] = cnt_ref[:].reshape(1, 1, 128)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    """Zero-pad `axis` up to a multiple of `mult` — at least one full
+    chunk, so a zero-size axis (e.g. a direction with no targets) still
+    yields a valid block (all-zero = matches nothing, allows nothing)."""
+    n = x.shape[axis]
+    pad = mult if n == 0 else (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def verdict_counts_pallas(
+    tmatch_e: jnp.ndarray,  # [T_e, N] bool
+    has_e: jnp.ndarray,  # [N] bool
+    tallow_e: jnp.ndarray,  # [T_e, N, Q] bf16 (0/1)
+    tmatch_i: jnp.ndarray,  # [T_i, N] bool
+    has_i: jnp.ndarray,  # [N] bool
+    tallow_i: jnp.ndarray,  # [T_i, N, Q] bf16 (0/1)
+    n_pods: int | jnp.ndarray = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """[Q, n_src_tiles, 3] int32 partial allow counts (ingress, egress,
+    combined) over the full N x N x Q grid, without materializing any
+    verdict tensor.  Partials are per (port case, src tile) so each stays
+    below 2^31; sum them in int64 on the host."""
+    n = tmatch_e.shape[1]
+    q = tallow_e.shape[2]
+    if n_pods is None:
+        n_pods = n
+    valid = (jnp.arange(n) < n_pods).astype(jnp.int32)
+
+    a_e = _pad_to(_pad_to(tmatch_e.astype(jnp.bfloat16), 0, KT), 1, BS).T
+    a_i = _pad_to(_pad_to(tmatch_i.astype(jnp.bfloat16), 0, KT), 1, BD)
+    b_e = _pad_to(
+        _pad_to(jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16), 1, KT), 2, BD
+    )  # [Q, T_e', N']
+    b_i = _pad_to(
+        _pad_to(jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16), 1, KT), 2, BS
+    )  # [Q, T_i', N']
+    has_e_p = _pad_to(has_e.astype(jnp.int32)[None, :], 1, BS)
+    has_i_p = _pad_to(has_i.astype(jnp.int32)[None, :], 1, BD)
+    valid_s = _pad_to(valid[None, :], 1, BS)
+    valid_d = _pad_to(valid[None, :], 1, BD)
+
+    n_pad = a_e.shape[0]
+    kt_e = b_e.shape[1]
+    kt_i = b_i.shape[1]
+    # one T-chunk count for both directions: pad both to the max so the
+    # k grid dimension is shared (extra chunks are all-zero rows)
+    kt = max(kt_e, kt_i)
+    a_e = _pad_to(a_e, 1, kt)
+    b_e = _pad_to(b_e, 1, kt)
+    a_i = _pad_to(a_i, 0, kt)
+    b_i = _pad_to(b_i, 1, kt)
+
+    n_i = n_pad // BS
+    # per-(q, src-tile) partial counts stay within int32: BS * n_pad
+    # allowed cells max per block
+    assert BS * n_pad < 2**31, (
+        f"pod axis {n_pad} too large for int32 tile counts at BS={BS}"
+    )
+    grid = (q, n_i, n_pad // BD, kt // KT)
+    counts = pl.pallas_call(
+        _verdict_counts_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BS, KT), lambda q, i, j, k: (i, k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, KT, BD), lambda q, i, j, k: (q, k, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, KT, BS), lambda q, i, j, k: (q, k, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((KT, BD), lambda q, i, j, k: (k, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BS), lambda q, i, j, k: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BD), lambda q, i, j, k: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BS), lambda q, i, j, k: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BD), lambda q, i, j, k: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_i, 128), lambda q, i, j, k: (q, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((q, n_i, 128), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((BS, BD), jnp.float32),
+            pltpu.VMEM((BS, BD), jnp.float32),
+            pltpu.VMEM((1, 128), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * q * n_pad * n_pad * kt,
+            bytes_accessed=2 * q * (n_pad // BS) * n_pad * kt * 2,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(a_e, b_e, b_i, a_i, has_e_p, has_i_p, valid_s, valid_d)
+    # [Q, n_i, 3] int32 partials; the caller sums them in numpy int64
+    # (jnp int64 silently truncates to int32 without jax_enable_x64)
+    return counts[:, :, :3]
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def evaluate_grid_counts_pallas(tensors: Dict, n_pods: int) -> Dict[str, int]:
+    """Drop-in alternative to tiled.evaluate_grid_counts riding the fused
+    Pallas kernel.  Per-(port case, src-tile) partials are int32-bounded
+    (BS * N < 2^31, asserted); totals are summed host-side in int64."""
+    from .tiled import _precompute_jit
+
+    pre = _precompute_jit(tensors)
+    partials = verdict_counts_pallas(
+        pre["egress"]["tmatch"],
+        pre["egress"]["has_target"],
+        pre["egress"]["tallow_bf"],
+        pre["ingress"]["tmatch"],
+        pre["ingress"]["has_target"],
+        pre["ingress"]["tallow_bf"],
+        n_pods=n_pods,
+        interpret=_should_interpret(),
+    )
+    import numpy as np
+
+    c = np.asarray(partials, dtype=np.int64).sum(axis=(0, 1))
+    q = int(tensors["q_port"].shape[0])
+    return {
+        "ingress": int(c[0]),
+        "egress": int(c[1]),
+        "combined": int(c[2]),
+        "cells": q * n_pods * n_pods,
+    }

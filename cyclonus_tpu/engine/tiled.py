@@ -202,6 +202,11 @@ def evaluate_grid_counts(
     One jit dispatch, one [n_tiles, 3] readback."""
     q = int(tensors["q_port"].shape[0])
     block = min(block, max(n_pods, 1))
+    # per-tile counts are int32: keep block * N * Q below 2^31 (the
+    # equivalent global-accumulator overflow bit the pallas backend at
+    # 100k pods before partials were introduced)
+    while block > 1 and block * n_pods * q >= 2**31:
+        block //= 2
     tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
     counts = np.asarray(
         _counts_kernel(tensors, block, n_tiles, n_pods), dtype=np.int64

@@ -37,11 +37,11 @@ def fuzz_problem(seed, n_extra_pods=0):
     nss = ["x", "y", "z"]
     keys = ["pod", "app", "ns", "team"]
     values = ["a", "b", "c", "x", "y", "z", "blue", "red"]
-    namespaces = {
-        ns: {"ns": ns, "team": rng.choice(["blue", "red"])} for ns in nss
-    }
-    pods, namespaces_d = default_cluster()
-    namespaces.update(namespaces_d)
+    pods, namespaces = default_cluster()
+    # fuzzed team labels layer ON TOP of the defaults so namespace-selector
+    # peers on "team" genuinely discriminate
+    for ns in nss:
+        namespaces[ns] = {"ns": ns, "team": rng.choice(["blue", "red"])}
     for i in range(n_extra_pods):
         ns = rng.choice(nss)
         pods.append(
