@@ -16,8 +16,9 @@ random sample of cells guards against benchmarking a wrong kernel.
 Env overrides: BENCH_PODS, BENCH_POLICIES, BENCH_SAMPLE (oracle spot-check
 size), BENCH_TILED (default 1: tiled counts mode, scales past HBM;
 0 = full-grid tables mode, needs BENCH_PODS <~ 25000 on one chip),
-BENCH_COUNTS_BACKEND (pallas | xla), BENCH_BLOCK (xla tile height),
-BENCH_SHARDED=1 (full-grid mode over a device mesh).
+BENCH_COUNTS_BACKEND (pallas | xla | sharded — mesh-parallel tile loop),
+BENCH_BLOCK (xla tile height), BENCH_SHARDED=1 (full-grid mode over a
+device mesh).
 """
 
 import json
@@ -180,15 +181,17 @@ def spot_check_pairs(engine, policy, pods, namespaces, cases, n_samples, rng):
 
 
 def main():
-    # default = the BASELINE.md north-star configuration (100k pods x 10k
-    # policies, full matrix), measured on the tiled fused-pallas path —
-    # the only mode that fits a single chip at this scale
-    n_pods = int(os.environ.get("BENCH_PODS", "100000"))
-    n_policies = int(os.environ.get("BENCH_POLICIES", "10000"))
     sharded = os.environ.get("BENCH_SHARDED", "") == "1"
     # BENCH_SHARDED selects the full-grid mesh path, which the tiled
     # default would otherwise shadow
     tiled = os.environ.get("BENCH_TILED", "1") == "1" and not sharded
+    # default = the BASELINE.md north-star configuration (100k pods x 10k
+    # policies, full matrix) on the tiled fused-pallas path — the only
+    # mode that fits a single chip at this scale; full-grid modes default
+    # to a size whose verdict tables actually fit in memory
+    default_pods, default_pols = ("100000", "10000") if tiled else ("10000", "1000")
+    n_pods = int(os.environ.get("BENCH_PODS", default_pods))
+    n_policies = int(os.environ.get("BENCH_POLICIES", default_pols))
     counts_backend = os.environ.get("BENCH_COUNTS_BACKEND", "pallas")
     block = int(os.environ.get("BENCH_BLOCK", "1024"))
     n_samples = int(os.environ.get("BENCH_SAMPLE", "25"))
@@ -212,6 +215,8 @@ def main():
         # counts mode: the whole tile loop runs device-side in one jit; the
         # [n_tiles, 3] readback is the execution barrier
         def run_tiled():
+            if counts_backend == "sharded":
+                return engine.evaluate_grid_counts_sharded(cases, block=block)
             return engine.evaluate_grid_counts(
                 cases, block=block, backend=counts_backend
             )
@@ -237,9 +242,14 @@ def main():
         sub_n = min(n_pods, 384)
         sub_pods = [pods[i] for i in sorted(rng.sample(range(n_pods), sub_n))]
         sub_engine = TpuPolicyEngine(policy, sub_pods, namespaces)
-        sub_counts = sub_engine.evaluate_grid_counts(
-            cases, block=100, backend=counts_backend
-        )
+        if counts_backend == "sharded":
+            sub_counts = sub_engine.evaluate_grid_counts_sharded(
+                cases, block=100
+            )
+        else:
+            sub_counts = sub_engine.evaluate_grid_counts(
+                cases, block=100, backend=counts_backend
+            )
         sub_grid = sub_engine.evaluate_grid(cases)
         expected = {
             "ingress": int(np.asarray(sub_grid.ingress).sum()),

@@ -284,7 +284,14 @@ class TpuPolicyEngine:
         (one device execution, one small readback).  backend="xla" runs
         the lax.fori_loop tile loop (engine/tiled.py); backend="pallas"
         runs the fused verdict+count Pallas kernel (engine/pallas_kernel.py,
-        interpret mode off-TPU) — identical results by construction."""
+        interpret mode off-TPU; its tile sizes are the kernel's BS/BD
+        constants, so `block` is ignored) — identical results by
+        construction."""
+        if backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown counts backend {backend!r} (want 'xla' or "
+                f"'pallas'; mesh-parallel = evaluate_grid_counts_sharded)"
+            )
         self._check_ips()
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
@@ -301,6 +308,22 @@ class TpuPolicyEngine:
         # the xla path pads the pod axis with numpy before dispatch
         return evaluate_grid_counts(
             self._tensors_with_cases(cases), n, block=block
+        )
+
+    def evaluate_grid_counts_sharded(
+        self, cases: Sequence[PortCase], block: int = 1024, mesh=None
+    ) -> Dict[str, int]:
+        """Mesh-parallel tiled counts: source rows split over the mesh,
+        per-device tile loop, one all-gather of partials (engine/tiled.py).
+        The multi-chip path for grids past one device's wall-clock."""
+        self._check_ips()
+        n = self.encoding.cluster.n_pods
+        if not cases or n == 0:
+            return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        from .tiled import evaluate_grid_counts_sharded
+
+        return evaluate_grid_counts_sharded(
+            self._tensors_with_cases(cases), n, block=block, mesh=mesh
         )
 
     def iter_grid_blocks(self, cases: Sequence[PortCase], block: int = 1024):

@@ -31,6 +31,21 @@ try:  # JAX >= 0.4.35 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+
+def shard_map_no_check(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication check disabled, under whichever
+    keyword this JAX spells it (check_vma >= 0.4.35ish, check_rep
+    before)."""
+    params = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False}
+        if "check_vma" in params
+        else ({"check_rep": False} if "check_rep" in params else {})
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **check_kw
+    )
+
 from .kernel import _bool_matmul, direction_precompute, port_spec_allows, selector_match
 
 # pod-axis-sharded tensor keys
@@ -210,21 +225,9 @@ def evaluate_grid_sharded(
         P("x", None, None),
     )
 
-    # disable the replication check under whichever keyword this JAX spells
-    # it (check_vma >= 0.4.35ish, check_rep before)
-    params = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False}
-        if "check_vma" in params
-        else ({"check_rep": False} if "check_rep" in params else {})
-    )
     fn = jax.jit(
-        shard_map(
-            _sharded_eval,
-            mesh=mesh,
-            in_specs=(in_specs,),
-            out_specs=out_specs,
-            **check_kw,
+        shard_map_no_check(
+            _sharded_eval, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
         )
     )
     ingress_rows, egress, combined = fn(tensors)

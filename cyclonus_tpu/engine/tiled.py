@@ -165,31 +165,43 @@ def _pad_pod_axis(tensors: Dict, n_pods: int, block: int) -> Tuple[Dict, int]:
     return _pad_pod_arrays(tensors, n_pods, n_tiles * block)[0], n_tiles
 
 
+def _tile_counts(pre: Dict, valid: jnp.ndarray, start, block: int) -> jnp.ndarray:
+    """[3] int32 validity-masked allow counts for source rows
+    [start, start+block) — THE per-tile count body, shared by the
+    single-device and mesh-parallel paths so the masking/count semantics
+    cannot diverge.  Safe in int32 for any block*N*Q that fits in HBM."""
+    ingress_rows, egress, combined = _tile_verdicts(pre, start, block)
+    src_valid = jax.lax.dynamic_slice(valid, (start,), (block,))
+    mask = src_valid[:, None, None] & valid[None, :, None]
+    return jnp.stack(
+        [
+            jnp.sum(ingress_rows & mask, dtype=jnp.int32),
+            jnp.sum(egress & mask, dtype=jnp.int32),
+            jnp.sum(combined & mask, dtype=jnp.int32),
+        ]
+    )
+
+
+def _int32_safe_block(block: int, n_pods: int, q: int) -> int:
+    """Halve the tile height until per-tile counts stay below 2^31."""
+    while block > 1 and block * n_pods * q >= 2**31:
+        block //= 2
+    return block
+
+
 @partial(jax.jit, static_argnames=("block", "n_tiles", "n_pods"))
 def _counts_kernel(
     tensors: Dict, block: int, n_tiles: int, n_pods: int
 ) -> jnp.ndarray:
     """[n_tiles, 3] int32 allow counts (ingress, egress, combined) over the
-    full grid, computed with one device execution.  Per-tile counts are
-    < 2^31 for any block*N*Q that fits in HBM, so int32 is safe; the host
-    sums tiles in int64."""
+    full grid, computed with one device execution; the host sums tiles in
+    int64."""
     pre = _precompute(tensors)
     n_padded = tensors["pod_ns_id"].shape[0]
     valid = jnp.arange(n_padded) < n_pods  # [N] pod-validity mask
 
     def body(i, counts):
-        start = i * block
-        ingress_rows, egress, combined = _tile_verdicts(pre, start, block)
-        src_valid = jax.lax.dynamic_slice(valid, (i * block,), (block,))
-        mask = src_valid[:, None, None] & valid[None, :, None]
-        row = jnp.stack(
-            [
-                jnp.sum(ingress_rows & mask, dtype=jnp.int32),
-                jnp.sum(egress & mask, dtype=jnp.int32),
-                jnp.sum(combined & mask, dtype=jnp.int32),
-            ]
-        )
-        return counts.at[i].set(row)
+        return counts.at[i].set(_tile_counts(pre, valid, i * block, block))
 
     counts = jnp.zeros((n_tiles, 3), dtype=jnp.int32)
     return jax.lax.fori_loop(0, n_tiles, body, counts)
@@ -201,12 +213,10 @@ def evaluate_grid_counts(
     """Allow counts over the full N x N x Q grid without materializing it.
     One jit dispatch, one [n_tiles, 3] readback."""
     q = int(tensors["q_port"].shape[0])
-    block = min(block, max(n_pods, 1))
     # per-tile counts are int32: keep block * N * Q below 2^31 (the
     # equivalent global-accumulator overflow bit the pallas backend at
     # 100k pods before partials were introduced)
-    while block > 1 and block * n_pods * q >= 2**31:
-        block //= 2
+    block = _int32_safe_block(min(block, max(n_pods, 1)), n_pods, q)
     tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
     counts = np.asarray(
         _counts_kernel(tensors, block, n_tiles, n_pods), dtype=np.int64
@@ -251,6 +261,69 @@ def iter_grid_blocks(
 
 
 _precompute_jit = jax.jit(_precompute)
+
+
+def evaluate_grid_counts_sharded(
+    tensors: Dict, n_pods: int, block: int = 1024, mesh=None
+) -> Dict[str, int]:
+    """Mesh-parallel tiled counts: the SOURCE-ROW axis is split over the
+    mesh; each device runs the XLA tile loop over its own row shard
+    against the full (replicated) per-direction precompute, and the
+    [n_tiles_local, 3] partials are summed across devices with one psum.
+    Combines the two scale axes: tiling lifts the per-device HBM ceiling,
+    sharding divides wall-clock by the mesh size (tiles are
+    embarrassingly parallel across source rows).
+
+    The per-pod precompute (selector matches, tallow) is evaluated
+    replicated — it is O(N), negligible next to the O(N^2) tile loop."""
+    from .sharded import _pad_pod_arrays, default_mesh, shard_map_no_check
+
+    mesh = mesh or default_mesh()
+    n_dev = mesh.devices.size
+    q = int(tensors["q_port"].shape[0])
+    block = _int32_safe_block(min(block, max(n_pods // n_dev, 1)), n_pods, q)
+    # pad so every device gets the same whole number of tiles
+    tensors, n_padded = _pad_pod_arrays(tensors, n_pods, n_dev * block)
+    tiles_per_dev = n_padded // (n_dev * block)
+
+    def per_device(t):
+        pre = _precompute(t)
+        # this device's source-row range
+        dev = jax.lax.axis_index("x")
+        row0 = dev * tiles_per_dev * block
+        valid = jnp.arange(n_padded) < n_pods
+
+        def body(i, counts):
+            return counts.at[i].set(
+                _tile_counts(pre, valid, row0 + i * block, block)
+            )
+
+        counts = jax.lax.fori_loop(
+            0,
+            tiles_per_dev,
+            body,
+            jnp.zeros((tiles_per_dev, 3), dtype=jnp.int32),
+        )
+        # one collective: gather every device's per-tile partials so the
+        # host can sum them in int64 (device int32 would overflow first)
+        return jax.lax.all_gather(counts, "x", axis=0, tiled=True)
+
+    from jax.sharding import PartitionSpec as P
+
+    in_specs = jax.tree_util.tree_map(lambda _: P(), tensors)
+    fn = jax.jit(
+        shard_map_no_check(
+            per_device, mesh=mesh, in_specs=(in_specs,), out_specs=P()
+        )
+    )
+    partials = np.asarray(fn(tensors), dtype=np.int64)
+    counts = partials.sum(axis=0)
+    return {
+        "ingress": int(counts[0]),
+        "egress": int(counts[1]),
+        "combined": int(counts[2]),
+        "cells": q * n_pods * n_pods,
+    }
 
 
 @jax.jit

@@ -85,6 +85,18 @@ class TestTiledCounts:
             "cells": 0,
         }
 
+    @pytest.mark.parametrize("seed,block", [(7, 2), (8, 16)])
+    def test_counts_sharded_match_kernel(self, seed, block):
+        """Mesh-parallel counts over the virtual multi-device mesh must
+        equal the single-device kernel's sums (pad rows per device)."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=11)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        counts = engine.evaluate_grid_counts_sharded(CASES, block=block)
+        assert counts["ingress"] == int(ing.sum())
+        assert counts["egress"] == int(egr.sum())
+        assert counts["combined"] == int(comb.sum())
+
 
 class TestTiledBlocks:
     @pytest.mark.parametrize("seed,block", [(4, 4), (5, 7), (6, 32)])
