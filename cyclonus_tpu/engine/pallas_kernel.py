@@ -33,6 +33,7 @@ Layout notes:
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Dict
 
@@ -162,18 +163,24 @@ def verdict_counts_pallas(
         n_pods = n
     valid = (jnp.arange(n) < n_pods).astype(jnp.int32)
 
-    a_e = _pad_to(_pad_to(tmatch_e.astype(jnp.bfloat16), 0, KT), 1, BS).T
-    a_i = _pad_to(_pad_to(tmatch_i.astype(jnp.bfloat16), 0, KT), 1, BD)
+    # the pod axis appears as BOTH src tiles (BS) and dst tiles (BD):
+    # pad every pod-axis operand to one common multiple so the two views
+    # agree on n_pad (padding src and dst independently silently dropped
+    # trailing dst rows whenever BS != BD rounded differently)
+    nb = math.lcm(BS, BD)
+
+    a_e = _pad_to(_pad_to(tmatch_e.astype(jnp.bfloat16), 0, KT), 1, nb).T
+    a_i = _pad_to(_pad_to(tmatch_i.astype(jnp.bfloat16), 0, KT), 1, nb)
     b_e = _pad_to(
-        _pad_to(jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16), 1, KT), 2, BD
+        _pad_to(jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16), 1, KT), 2, nb
     )  # [Q, T_e', N']
     b_i = _pad_to(
-        _pad_to(jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16), 1, KT), 2, BS
+        _pad_to(jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16), 1, KT), 2, nb
     )  # [Q, T_i', N']
-    has_e_p = _pad_to(has_e.astype(jnp.int32)[None, :], 1, BS)
-    has_i_p = _pad_to(has_i.astype(jnp.int32)[None, :], 1, BD)
-    valid_s = _pad_to(valid[None, :], 1, BS)
-    valid_d = _pad_to(valid[None, :], 1, BD)
+    has_e_p = _pad_to(has_e.astype(jnp.int32)[None, :], 1, nb)
+    has_i_p = _pad_to(has_i.astype(jnp.int32)[None, :], 1, nb)
+    valid_s = _pad_to(valid[None, :], 1, nb)
+    valid_d = _pad_to(valid[None, :], 1, nb)
 
     n_pad = a_e.shape[0]
     kt_e = b_e.shape[1]

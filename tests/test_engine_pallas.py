@@ -38,3 +38,24 @@ class TestPallasCounts:
         a = engine.evaluate_grid_counts(CASES, block=8, backend="xla")
         b = engine.evaluate_grid_counts(CASES, backend="pallas")
         assert a == b
+
+    def test_unequal_src_dst_tiles(self, monkeypatch):
+        """Regression: with BS != BD the pod axis must pad to a COMMON
+        multiple — independent rounding silently dropped trailing dst
+        rows (caught as a count mismatch in a 100k tile-size sweep)."""
+        import cyclonus_tpu.engine.pallas_kernel as pk
+
+        policy, pods, namespaces = fuzz_problem(13, n_extra_pods=10)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, block=8, backend="xla")
+        import jax
+
+        for bs, bd in [(256, 512), (512, 256)]:
+            monkeypatch.setattr(pk, "BS", bs)
+            monkeypatch.setattr(pk, "BD", bd)
+            # BS/BD are read at trace time but are NOT part of the jit
+            # cache key; identical input shapes would silently reuse the
+            # previous configuration's executable
+            jax.clear_caches()
+            got = engine.evaluate_grid_counts(CASES, backend="pallas")
+            assert got == want, (bs, bd, got, want)
