@@ -1,0 +1,22 @@
+# Runtime image for the CLI + in-pod worker (reference:
+# cmd/cyclonus/Dockerfile builds an alpine image around a static binary;
+# the Python equivalent ships the package with a CPU jax).
+FROM python:3.12-slim
+
+# g++ lets native/build.py compile the C++ grid evaluator on demand
+# (--engine native); kubectl is NOT baked in — mount one for real-cluster
+# commands
+RUN apt-get update && apt-get install -y --no-install-recommends g++ && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY cyclonus_tpu ./cyclonus_tpu
+RUN pip install --no-cache-dir .
+
+# the in-pod worker is exec'd as `/worker --jobs <json>` by the batch
+# runner (probe/runner.py); alias both entrypoints to match
+RUN printf '#!/bin/sh\nexec cyclonus-tpu-worker "$@"\n' > /worker && \
+    chmod +x /worker
+
+ENTRYPOINT ["cyclonus-tpu"]

@@ -1,0 +1,23 @@
+# Mirrors the reference's Makefile targets (test/fmt/vet/build) in Python
+# form (reference Makefile:1-12).
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+fmt:
+	python -m black cyclonus_tpu tests bench.py 2>/dev/null || \
+	  echo "black not installed; skipping"
+
+vet:
+	python -m compileall -q cyclonus_tpu tests bench.py __graft_entry__.py
+
+cyclonus:
+	pip install -e .
+
+docker:
+	docker build -t cyclonus-tpu:latest .
+
+.PHONY: test bench fmt vet cyclonus docker

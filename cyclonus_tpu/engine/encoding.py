@@ -145,15 +145,76 @@ class ClusterEncoding:
 def _encode_label_rows(
     label_maps: Sequence[Dict[str, str]], vocab: _Vocab
 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Vocab-encode per-row label maps to padded id matrices.
+
+    Vectorized for large clusters: per-row dict walks produce flat
+    (row, key, value) triples, the vocab lookup runs once per DISTINCT
+    pair/key (label cardinality is tiny next to pod count), and the
+    padded matrices fill with one scatter.  Vocab id assignment order is
+    identical to the scalar form (first appearance in row-major sorted
+    order), so selector tables encoded earlier against the same vocab
+    stay consistent."""
     max_l = max((len(m) for m in label_maps), default=0)
     max_l = max(max_l, 1)
-    kv = np.full((len(label_maps), max_l), -1, dtype=np.int32)
-    key = np.full((len(label_maps), max_l), -1, dtype=np.int32)
+    n = len(label_maps)
+    kv = np.full((n, max_l), -1, dtype=np.int32)
+    key = np.full((n, max_l), -1, dtype=np.int32)
+    rows, cols, ks, vs = [], [], [], []
     for i, m in enumerate(label_maps):
         for j, (k, v) in enumerate(sorted(m.items())):
-            kv[i, j] = vocab.kv_id(k, v)
-            key[i, j] = vocab.key_id(k)
+            rows.append(i)
+            cols.append(j)
+            ks.append(k)
+            vs.append(v)
+    if not rows:
+        return kv, key
+    # id-assign in first-appearance order over the flat stream, visiting
+    # the dict only once per distinct pair/key
+    kv_ids = np.empty(len(rows), dtype=np.int32)
+    key_ids = np.empty(len(rows), dtype=np.int32)
+    kv_cache: Dict[Tuple[str, str], int] = {}
+    key_cache: Dict[str, int] = {}
+    for idx, (k, v) in enumerate(zip(ks, vs)):
+        pair = (k, v)
+        kv_cached = kv_cache.get(pair)
+        if kv_cached is None:
+            kv_cached = kv_cache[pair] = vocab.kv_id(k, v)
+        kv_ids[idx] = kv_cached
+        key_cached = key_cache.get(k)
+        if key_cached is None:
+            key_cached = key_cache[k] = vocab.key_id(k)
+        key_ids[idx] = key_cached
+    kv[rows, cols] = kv_ids
+    key[rows, cols] = key_ids
     return kv, key
+
+
+def _fast_ipv4_to_uint32(ip: str) -> Optional[int]:
+    """Dotted-quad fast path for the per-pod encode loop (ipaddress.
+    ip_address costs ~4us/call, dominating 100k+-pod encodes); anything
+    unusual falls back to the oracle-faithful ip_to_uint32."""
+    parts = ip.split(".")
+    if len(parts) != 4:
+        return ip_to_uint32(ip)
+    out = 0
+    for x in parts:
+        # reject forms ipaddress rejects: empty/oversize octets, signs,
+        # whitespace, non-ASCII digits (isdigit alone accepts those and
+        # int() converts them), leading zeros, out-of-range values
+        n = len(x)
+        if (
+            n == 0
+            or n > 3
+            or not x.isascii()
+            or not x.isdigit()
+            or (n > 1 and x[0] == "0")
+        ):
+            return ip_to_uint32(ip)
+        v = int(x)
+        if v > 255:
+            return ip_to_uint32(ip)
+        out = (out << 8) | v
+    return out
 
 
 def encode_cluster(
@@ -185,7 +246,7 @@ def encode_cluster(
     ) if pods else np.zeros((0,), dtype=np.int32)
     pod_kv, pod_key = _encode_label_rows([p[2] for p in pods], vocab)
     ips = [p[3] for p in pods]
-    ip_ints = [ip_to_uint32(ip) for ip in ips]
+    ip_ints = [_fast_ipv4_to_uint32(ip) for ip in ips]
     pod_ip = np.array([i or 0 for i in ip_ints], dtype=np.uint32)
     pod_ip_valid = np.array([i is not None for i in ip_ints], dtype=bool)
     return ClusterEncoding(
@@ -208,13 +269,25 @@ class _SelectorTable:
 
     index: Dict[str, int] = field(default_factory=dict)
     selectors: List[LabelSelector] = field(default_factory=list)
+    # object-level memo in front of the serialize-keyed dedup: selectors
+    # are frozen/hashable, and serialize_label_selector (json.dumps) is
+    # the encode hot spot at 10k+ policies.  Memo and serialization read
+    # the same fields (serialization preserves expression order, as does
+    # dataclass equality), so the memo can never merge selectors the
+    # index would keep distinct.
+    _memo: Dict[LabelSelector, int] = field(default_factory=dict)
 
     def sel_id(self, selector: LabelSelector) -> int:
+        sid = self._memo.get(selector)
+        if sid is not None:
+            return sid
         key = serialize_label_selector(selector)
         if key not in self.index:
             self.index[key] = len(self.selectors)
             self.selectors.append(selector)
-        return self.index[key]
+        sid = self.index[key]
+        self._memo[selector] = sid
+        return sid
 
     def encode(self, vocab: _Vocab):
         n = len(self.selectors)

@@ -50,12 +50,17 @@ class TestPallasCounts:
         want = engine.evaluate_grid_counts(CASES, block=8, backend="xla")
         import jax
 
-        for bs, bd in [(256, 512), (512, 256)]:
-            monkeypatch.setattr(pk, "BS", bs)
-            monkeypatch.setattr(pk, "BD", bd)
-            # BS/BD are read at trace time but are NOT part of the jit
-            # cache key; identical input shapes would silently reuse the
-            # previous configuration's executable
+        try:
+            for bs, bd in [(256, 512), (512, 256)]:
+                monkeypatch.setattr(pk, "BS", bs)
+                monkeypatch.setattr(pk, "BD", bd)
+                # BS/BD are read at trace time but are NOT part of the jit
+                # cache key; identical input shapes would silently reuse
+                # the previous configuration's executable
+                jax.clear_caches()
+                got = engine.evaluate_grid_counts(CASES, backend="pallas")
+                assert got == want, (bs, bd, got, want)
+        finally:
+            # don't leave a non-default-tiling executable in the global
+            # cache for later tests with identical input shapes
             jax.clear_caches()
-            got = engine.evaluate_grid_counts(CASES, backend="pallas")
-            assert got == want, (bs, bd, got, want)
