@@ -326,6 +326,23 @@ class TpuPolicyEngine:
             self._tensors_with_cases(cases), n, block=block, mesh=mesh
         )
 
+    def evaluate_grid_counts_ring(
+        self, cases: Sequence[PortCase], block: int = 1024, mesh=None
+    ) -> Dict[str, int]:
+        """Ring-rotation counts: both pod axes stay sharded and the
+        dst-side precompute rotates around the mesh with ppermute —
+        per-device memory O(N / mesh size), the path for clusters whose
+        precompute exceeds one device (engine/tiled.py)."""
+        self._check_ips()
+        n = self.encoding.cluster.n_pods
+        if not cases or n == 0:
+            return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        from .tiled import evaluate_grid_counts_ring
+
+        return evaluate_grid_counts_ring(
+            self._tensors_with_cases(cases), n, block=block, mesh=mesh
+        )
+
     def iter_grid_blocks(self, cases: Sequence[PortCase], block: int = 1024):
         """Stream verdict blocks of source rows to the host:
         yields (start, ingress_rows, egress, combined), arrays [b, N, Q]

@@ -52,6 +52,33 @@ from .kernel import _bool_matmul, direction_precompute, port_spec_allows, select
 _POD_KEYS = ("pod_ns_id", "pod_kv", "pod_key", "pod_ip", "pod_ip_valid")
 
 
+def pod_sharded_in_specs(tensors: Dict) -> Dict:
+    """shard_map in_specs for an engine tensor dict: per-pod arrays (and
+    host-evaluated ip-match rows) sharded over mesh axis 'x', policy
+    tensors replicated.  Shared by every pod-axis-sharded program
+    (full-grid sharded, ring counts) so a new tensor key cannot end up
+    sharded in one and replicated in the other."""
+    in_specs: Dict = {}
+    for k, v in tensors.items():
+        if k in _POD_KEYS:
+            in_specs[k] = (
+                P("x") if np.ndim(v) == 1 else P("x", *([None] * (np.ndim(v) - 1)))
+            )
+        elif k in ("ingress", "egress"):
+            sub = {}
+            for kk, vv in v.items():
+                if kk == "host_ip_match":
+                    sub[kk] = P(None, "x")
+                elif kk == "port_spec":
+                    sub[kk] = {k3: P() for k3 in vv}
+                else:
+                    sub[kk] = P()
+            in_specs[k] = sub
+        else:
+            in_specs[k] = P()
+    return in_specs
+
+
 def default_mesh() -> Mesh:
     """All devices of the default backend; when that's a single chip (e.g. a
     tunneled TPU) but the CPU backend exposes a virtual multi-device mesh
@@ -202,22 +229,7 @@ def evaluate_grid_sharded(
     n_dev = mesh.devices.size
     tensors, _padded_n = _pad_pod_arrays(tensors, n_pods, n_dev)
 
-    in_specs = {}
-    for k, v in tensors.items():
-        if k in _POD_KEYS:
-            in_specs[k] = P("x") if np.ndim(v) == 1 else P("x", *([None] * (np.ndim(v) - 1)))
-        elif k in ("ingress", "egress"):
-            sub = {}
-            for kk, vv in v.items():
-                if kk == "host_ip_match":
-                    sub[kk] = P(None, "x")
-                elif kk == "port_spec":
-                    sub[kk] = {k3: P() for k3 in vv}
-                else:
-                    sub[kk] = P()
-            in_specs[k] = sub
-        else:
-            in_specs[k] = P()
+    in_specs = pod_sharded_in_specs(tensors)
 
     out_specs = (
         P("x", None, None),

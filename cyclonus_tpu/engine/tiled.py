@@ -113,46 +113,75 @@ def _precompute(tensors: Dict) -> Dict[str, Dict[str, jnp.ndarray]]:
     return out
 
 
-def _tile_verdicts(
-    pre: Dict, start: jnp.ndarray, block: int
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Verdict blocks for source rows [start, start+block):
-    (ingress_rows, egress, combined), each [B, N, Q] bool, where
-    ingress_rows[b, d, q] = ingress verdict for dst d <- src (start+b)."""
-    pe, pi = pre["egress"], pre["ingress"]
-    t_e, n, q = pe["tallow_bf"].shape
-    t_i = pi["tallow_bf"].shape[0]
+def _split_pre(pre: Dict) -> Tuple[Dict, Dict]:
+    """Split the per-direction precompute into the SRC-side view (the
+    tile's source rows: egress target side + ingress peer side) and the
+    DST-side view (egress peer side + ingress target side).  On a single
+    device both views slice the same arrays; in the ring path the dst
+    view is the rotating remote shard."""
+    src = {
+        "tmatch_e": pre["egress"]["tmatch"],
+        "has_e": pre["egress"]["has_target"],
+        "tallow_i": pre["ingress"]["tallow_bf"],
+    }
+    dst = {
+        "tallow_e": pre["egress"]["tallow_bf"],
+        "tmatch_i": pre["ingress"]["tmatch"],
+        "has_i": pre["ingress"]["has_target"],
+    }
+    return src, dst
 
-    # egress: local source block is the TARGET side; peer side = all dsts
-    tme = jax.lax.dynamic_slice(pe["tmatch"], (0, start), (t_e, block))  # [T, B]
-    hte = jax.lax.dynamic_slice(pe["has_target"], (start,), (block,))  # [B]
+
+def _tile_verdicts_split(
+    src: Dict, dst: Dict, start: jnp.ndarray, block: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Verdict blocks for source rows [start, start+block) of the src
+    view against ALL dst-view pods: (ingress_rows, egress, combined),
+    each [B, Nd, Q] bool; ingress_rows[b, d, q] = ingress verdict for
+    dst d <- src (start+b).  THE per-tile verdict body — every tiled
+    path (single-device, mesh-parallel, ring) goes through here so the
+    semantics cannot diverge."""
+    t_e, nd, q = dst["tallow_e"].shape
+    t_i = dst["tmatch_i"].shape[0]
+
+    # egress: the source block is the TARGET side; peer side = dst pods
+    tme = jax.lax.dynamic_slice(src["tmatch_e"], (0, start), (t_e, block))
+    hte = jax.lax.dynamic_slice(src["has_e"], (start,), (block,))  # [B]
     any_e = (
         jnp.matmul(
             tme.T.astype(jnp.bfloat16),
-            pe["tallow_bf"].reshape(t_e, n * q),
+            dst["tallow_e"].reshape(t_e, nd * q),
             preferred_element_type=jnp.bfloat16,
         )
         > 0
-    ).reshape(block, n, q)
-    egress = (~hte[:, None, None]) | any_e  # [B, N, Q]
+    ).reshape(block, nd, q)
+    egress = (~hte[:, None, None]) | any_e  # [B, Nd, Q]
 
-    # ingress: local source block is the PEER side; target side = all dsts
+    # ingress: the source block is the PEER side; target side = dst pods
     tli = jax.lax.dynamic_slice(
-        pi["tallow_bf"], (0, start, 0), (t_i, block, q)
+        src["tallow_i"], (0, start, 0), (t_i, block, q)
     )  # [T, B, Q]
     any_i = (
         jnp.matmul(
-            pi["tmatch"].T.astype(jnp.bfloat16),
+            dst["tmatch_i"].T.astype(jnp.bfloat16),
             tli.reshape(t_i, block * q),
             preferred_element_type=jnp.bfloat16,
         )
         > 0
-    ).reshape(n, block, q)
-    ingress_t = (~pi["has_target"][:, None, None]) | any_i  # [N_dst, B, Q]
-    ingress_rows = jnp.swapaxes(ingress_t, 0, 1)  # [B, N_dst, Q]
+    ).reshape(nd, block, q)
+    ingress_t = (~dst["has_i"][:, None, None]) | any_i  # [Nd, B, Q]
+    ingress_rows = jnp.swapaxes(ingress_t, 0, 1)  # [B, Nd, Q]
 
     combined = egress & ingress_rows
     return ingress_rows, egress, combined
+
+
+def _tile_verdicts(
+    pre: Dict, start: jnp.ndarray, block: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Single-array-set form of _tile_verdicts_split (src == dst)."""
+    src, dst = _split_pre(pre)
+    return _tile_verdicts_split(src, dst, start, block)
 
 
 def _pad_pod_axis(tensors: Dict, n_pods: int, block: int) -> Tuple[Dict, int]:
@@ -165,14 +194,22 @@ def _pad_pod_axis(tensors: Dict, n_pods: int, block: int) -> Tuple[Dict, int]:
     return _pad_pod_arrays(tensors, n_pods, n_tiles * block)[0], n_tiles
 
 
-def _tile_counts(pre: Dict, valid: jnp.ndarray, start, block: int) -> jnp.ndarray:
-    """[3] int32 validity-masked allow counts for source rows
-    [start, start+block) — THE per-tile count body, shared by the
-    single-device and mesh-parallel paths so the masking/count semantics
-    cannot diverge.  Safe in int32 for any block*N*Q that fits in HBM."""
-    ingress_rows, egress, combined = _tile_verdicts(pre, start, block)
-    src_valid = jax.lax.dynamic_slice(valid, (start,), (block,))
-    mask = src_valid[:, None, None] & valid[None, :, None]
+def _tile_counts_split(
+    src: Dict,
+    dst: Dict,
+    src_valid: jnp.ndarray,
+    dst_valid: jnp.ndarray,
+    start,
+    block: int,
+) -> jnp.ndarray:
+    """[3] int32 validity-masked allow counts for src-view rows
+    [start, start+block) against all dst-view pods — THE per-tile count
+    body, shared by the single-device, mesh-parallel, and ring paths so
+    the masking/count semantics cannot diverge.  Safe in int32 for any
+    block*Nd*Q that fits in HBM."""
+    ingress_rows, egress, combined = _tile_verdicts_split(src, dst, start, block)
+    sv = jax.lax.dynamic_slice(src_valid, (start,), (block,))
+    mask = sv[:, None, None] & dst_valid[None, :, None]
     return jnp.stack(
         [
             jnp.sum(ingress_rows & mask, dtype=jnp.int32),
@@ -180,6 +217,12 @@ def _tile_counts(pre: Dict, valid: jnp.ndarray, start, block: int) -> jnp.ndarra
             jnp.sum(combined & mask, dtype=jnp.int32),
         ]
     )
+
+
+def _tile_counts(pre: Dict, valid: jnp.ndarray, start, block: int) -> jnp.ndarray:
+    """Single-array-set form of _tile_counts_split (src == dst)."""
+    src, dst = _split_pre(pre)
+    return _tile_counts_split(src, dst, valid, valid, start, block)
 
 
 def _int32_safe_block(block: int, n_pods: int, q: int) -> int:
@@ -261,6 +304,100 @@ def iter_grid_blocks(
 
 
 _precompute_jit = jax.jit(_precompute)
+
+
+def evaluate_grid_counts_ring(
+    tensors: Dict, n_pods: int, block: int = 1024, mesh=None
+) -> Dict[str, int]:
+    """Ring-rotation counts: BOTH pod axes stay sharded.
+
+    evaluate_grid_counts_sharded replicates the dst-side precompute
+    (tallow is [T, N, Q] bf16 — the memory ceiling at large N); here each
+    device keeps only its OWN pod shard's precompute, and the dst-side
+    block rotates around the ring with jax.lax.ppermute, one hop per
+    step — structurally the ring-attention/blockwise pattern from
+    SURVEY.md §5 with verdict tiles in place of attention blocks:
+
+        for step in range(n_dev):
+            counts += local_src_rows x current_dst_block   (MXU tiles)
+            dst_block <- left neighbor                      (ICI ppermute)
+
+    Per-device memory is O(N/n_dev) instead of O(N), so max cluster size
+    scales linearly with the mesh.  The rotating state is the
+    (tallow_e, tmatch_i, has_i, tallow_i, tmatch_e-free) dst bundle; the
+    ppermute overlaps with the next step's tile matmuls under XLA's
+    scheduler."""
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import (
+        _pad_pod_arrays,
+        default_mesh,
+        pod_sharded_in_specs,
+        shard_map_no_check,
+    )
+
+    mesh = mesh or default_mesh()
+    n_dev = mesh.devices.size
+    q = int(tensors["q_port"].shape[0])
+    block = _int32_safe_block(min(block, max(n_pods // n_dev, 1)), n_pods, q)
+    tensors, n_padded = _pad_pod_arrays(tensors, n_pods, n_dev * block)
+    shard = n_padded // n_dev
+    tiles_per_shard = shard // block
+
+    def per_device(t):
+        # local precompute over THIS device's pod shard only (t's pod
+        # arrays arrive shard-sharded via in_specs)
+        pre = _precompute(t)
+        dev = jax.lax.axis_index("x")
+        row0 = dev * shard
+        valid_local = (jnp.arange(shard) + row0) < n_pods  # [shard]
+
+        # src view stays local; the dst view (+ its validity mask) is the
+        # rotating ring bundle, seeded with our own shard's dst-side view
+        src, dst0 = _split_pre(pre)
+        ring = dict(dst0, valid=valid_local)
+
+        def ring_step(step, carry):
+            counts, ring = carry
+            dst = {k: ring[k] for k in ("tallow_e", "tmatch_i", "has_i")}
+
+            def tile(i, counts):
+                row = _tile_counts_split(
+                    src, dst, valid_local, ring["valid"], i * block, block
+                )
+                return counts.at[step * tiles_per_shard + i].set(row)
+
+            counts = jax.lax.fori_loop(0, tiles_per_shard, tile, counts)
+            # rotate the dst bundle one hop around the ring.  The final
+            # rotation (returning every bundle to its origin) is kept
+            # rather than guarded out: collectives under lax.cond don't
+            # lower reliably, and the extra hop is one ICI transfer.
+            perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+            ring = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, "x", perm), ring
+            )
+            return counts, ring
+
+        counts = jnp.zeros((n_dev * tiles_per_shard, 3), dtype=jnp.int32)
+        counts, _ = jax.lax.fori_loop(0, n_dev, ring_step, (counts, ring))
+        return jax.lax.all_gather(counts, "x", axis=0, tiled=True)
+
+    fn = jax.jit(
+        shard_map_no_check(
+            per_device,
+            mesh=mesh,
+            in_specs=(pod_sharded_in_specs(tensors),),
+            out_specs=P(),
+        )
+    )
+    partials = np.asarray(fn(tensors), dtype=np.int64)
+    counts = partials.sum(axis=0)
+    return {
+        "ingress": int(counts[0]),
+        "egress": int(counts[1]),
+        "combined": int(counts[2]),
+        "cells": q * n_pods * n_pods,
+    }
 
 
 def evaluate_grid_counts_sharded(

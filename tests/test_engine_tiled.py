@@ -85,6 +85,60 @@ class TestTiledCounts:
             "cells": 0,
         }
 
+    @pytest.mark.parametrize("seed,block", [(20, 2), (21, 8)])
+    def test_counts_ring_match_kernel(self, seed, block):
+        """Ring-rotation counts (both axes sharded, ppermute per step)
+        must equal the single-device kernel's sums."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=13)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        counts = engine.evaluate_grid_counts_ring(CASES, block=block)
+        assert counts["ingress"] == int(ing.sum())
+        assert counts["egress"] == int(egr.sum())
+        assert counts["combined"] == int(comb.sum())
+
+    def test_counts_ring_ipv6_host_rows(self):
+        """host_ip_match rows are pod-axis sharded in the ring path — on
+        BOTH sides: the ingress policy patches the local (peer) view, the
+        egress policy's patched rows are baked into the tallow bundle
+        that rotates around the ring."""
+        from cyclonus_tpu.kube.netpol import (
+            IPBlock,
+            LabelSelector,
+            NetworkPolicyEgressRule,
+            NetworkPolicyIngressRule,
+            NetworkPolicyPeer,
+        )
+        from cyclonus_tpu.matcher import build_network_policies
+        from test_engine_parity import default_cluster, mkpol
+
+        pods, namespaces = default_cluster()
+        pods = [
+            (ns, name, labels, ip if i % 2 else f"2001:db8::{i + 1}")
+            for i, (ns, name, labels, ip) in enumerate(pods)
+        ]
+        v6_peer = NetworkPolicyPeer(ip_block=IPBlock.make("2001:db8::/112", []))
+        pol_i = mkpol(
+            "v6-in",
+            "x",
+            LabelSelector.make(),
+            ["Ingress"],
+            ingress=[NetworkPolicyIngressRule(ports=[], from_=[v6_peer])],
+        )
+        pol_e = mkpol(
+            "v6-eg",
+            "y",
+            LabelSelector.make(),
+            ["Egress"],
+            egress=[NetworkPolicyEgressRule(ports=[], to=[v6_peer])],
+        )
+        policy = build_network_policies(True, [pol_i, pol_e])
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        counts = engine.evaluate_grid_counts_ring(CASES, block=2)
+        assert counts["combined"] == int(comb.sum())
+        assert counts["ingress"] == int(ing.sum())
+
     @pytest.mark.parametrize("seed,block", [(7, 2), (8, 16)])
     def test_counts_sharded_match_kernel(self, seed, block):
         """Mesh-parallel counts over the virtual multi-device mesh must
