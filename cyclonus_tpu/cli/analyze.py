@@ -43,6 +43,12 @@ def setup_analyze(sub) -> None:
         default=[],
         help="namespaces to read policies from a live cluster (via kubectl)",
     )
+    cmd.add_argument(
+        "-A",
+        "--all-namespaces",
+        action="store_true",
+        help="read policies from all namespaces (kubectl's -A)",
+    )
     cmd.add_argument("--context", default="", help="kube context")
     cmd.add_argument(
         "--simplify-policies",
@@ -74,12 +80,18 @@ def _bool_action():
 
 def _read_policies(args) -> List[NetworkPolicy]:
     policies: List[NetworkPolicy] = []
-    if args.namespace:
+    if args.namespace and args.all_namespaces:
+        # kubectl rejects this combination too
+        raise SystemExit("--namespace and --all-namespaces are mutually exclusive")
+    if args.namespace or args.all_namespaces:
         from ..kube.kubectl import KubectlKubernetes
 
         kube = KubectlKubernetes(args.context)
-        for ns in args.namespace:
-            policies.extend(kube.get_network_policies_in_namespace(ns))
+        if args.all_namespaces:
+            policies.extend(kube.get_network_policies_all_namespaces())
+        else:
+            for ns in args.namespace:
+                policies.extend(kube.get_network_policies_in_namespace(ns))
     if args.policy_path:
         policies.extend(load_policies_from_path(args.policy_path))
     if args.use_example_policies:

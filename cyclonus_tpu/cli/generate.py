@@ -9,6 +9,7 @@ from ..connectivity import Interpreter, InterpreterConfig, Printer
 from ..generator import TestCaseGenerator
 from ..generator.tags import validate_tags
 from ..kube.ikubernetes import IKubernetes, MockKubernetes
+from ..probe.probeconfig import ALL_PROBE_MODES, ProbeMode
 from ..probe.resources import Resources
 
 
@@ -23,6 +24,13 @@ def setup_generate(sub) -> None:
         help="with --mock: emulate a policy-correct CNI (all cases should pass)",
     )
     cmd.add_argument("--dry-run", action="store_true", help="print cases without running")
+    cmd.add_argument(
+        "--destination-type",
+        default="",
+        choices=[""] + [str(m) for m in ALL_PROBE_MODES],
+        help="override every test step's probe destination (generate.go"
+        ":131-139); leave empty to keep per-case modes",
+    )
     cmd.add_argument("--context", default="", help="kube context")
     cmd.add_argument(
         "--server-namespace", action="append", default=None, help="namespaces (default x,y,z)"
@@ -157,6 +165,14 @@ def run_generate(args) -> int:
         for i, tc in enumerate(cases):
             print(f"{i + 1}: {tc.description} (tags: {', '.join(tc.tags.keys_sorted())})")
         return 0
+
+    if args.destination_type:
+        # override every step's probe mode (generate.go:131-139)
+        mode = ProbeMode(args.destination_type)
+        for tc in cases:
+            for step in tc.steps:
+                if step.probe is not None:
+                    step.probe = step.probe.with_mode(mode)
 
     config = InterpreterConfig(
         reset_cluster_before_test_case=True,

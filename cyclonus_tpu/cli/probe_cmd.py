@@ -58,6 +58,20 @@ def setup_probe(sub) -> None:
     cmd.add_argument(
         "--pod-creation-timeout-seconds", type=int, default=60, help="pod creation timeout"
     )
+    cmd.add_argument(
+        "--perturbation-wait-seconds",
+        type=int,
+        default=5,
+        help="wait after applying policies before probing (ignored with --mock)",
+    )
+    cmd.add_argument(
+        "--noisy", action="store_true", help="print all tables, not just discrepancies"
+    )
+    cmd.add_argument(
+        "--ignore-loopback",
+        action="store_true",
+        help="ignore loopback cells in correctness verification",
+    )
     cmd.set_defaults(func=run_probe)
 
 
@@ -108,12 +122,13 @@ def run_probe(args) -> int:
     )
     config = InterpreterConfig(
         kube_probe_retries=0,
-        perturbation_wait_seconds=0,
+        perturbation_wait_seconds=0 if args.mock else args.perturbation_wait_seconds,
         simulated_engine=args.engine,
         pod_wait_timeout_seconds=args.pod_creation_timeout_seconds,
+        ignore_loopback=args.ignore_loopback,
     )
     interpreter = Interpreter(kubernetes, resources, config)
     result = interpreter.execute_test_case(test_case)
-    printer = Printer(noisy=True)
+    printer = Printer(noisy=args.noisy, ignore_loopback=args.ignore_loopback)
     printer.print_test_case_result(result)
     return 0

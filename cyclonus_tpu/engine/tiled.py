@@ -306,6 +306,44 @@ def iter_grid_blocks(
 _precompute_jit = jax.jit(_precompute)
 
 
+def _mesh_counts_setup(tensors: Dict, n_pods: int, block: int, mesh):
+    """Shared mesh/count-path setup: resolve the mesh, bound the tile
+    height for int32 partials, and pad the pod axis so every device gets
+    a whole number of tiles."""
+    from .sharded import _pad_pod_arrays, default_mesh
+
+    mesh = mesh or default_mesh()
+    n_dev = mesh.devices.size
+    q = int(tensors["q_port"].shape[0])
+    block = _int32_safe_block(min(block, max(n_pods // n_dev, 1)), n_pods, q)
+    tensors, n_padded = _pad_pod_arrays(tensors, n_pods, n_dev * block)
+    return mesh, n_dev, q, block, tensors, n_padded
+
+
+def _run_mesh_counts(
+    per_device, mesh, in_specs, tensors: Dict, q: int, n_pods: int
+) -> Dict[str, int]:
+    """Shared tail of every mesh count path: one shard_map execution,
+    then the int64 host sum of the [*, 3] int32 partials (device-side
+    int64 silently truncates without jax_enable_x64)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import shard_map_no_check
+
+    fn = jax.jit(
+        shard_map_no_check(
+            per_device, mesh=mesh, in_specs=(in_specs,), out_specs=P()
+        )
+    )
+    counts = np.asarray(fn(tensors), dtype=np.int64).sum(axis=0)
+    return {
+        "ingress": int(counts[0]),
+        "egress": int(counts[1]),
+        "combined": int(counts[2]),
+        "cells": q * n_pods * n_pods,
+    }
+
+
 def evaluate_grid_counts_ring(
     tensors: Dict, n_pods: int, block: int = 1024, mesh=None
 ) -> Dict[str, int]:
@@ -327,20 +365,11 @@ def evaluate_grid_counts_ring(
     (tallow_e, tmatch_i, has_i, tallow_i, tmatch_e-free) dst bundle; the
     ppermute overlaps with the next step's tile matmuls under XLA's
     scheduler."""
-    from jax.sharding import PartitionSpec as P
+    from .sharded import pod_sharded_in_specs
 
-    from .sharded import (
-        _pad_pod_arrays,
-        default_mesh,
-        pod_sharded_in_specs,
-        shard_map_no_check,
+    mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
+        tensors, n_pods, block, mesh
     )
-
-    mesh = mesh or default_mesh()
-    n_dev = mesh.devices.size
-    q = int(tensors["q_port"].shape[0])
-    block = _int32_safe_block(min(block, max(n_pods // n_dev, 1)), n_pods, q)
-    tensors, n_padded = _pad_pod_arrays(tensors, n_pods, n_dev * block)
     shard = n_padded // n_dev
     tiles_per_shard = shard // block
 
@@ -382,22 +411,9 @@ def evaluate_grid_counts_ring(
         counts, _ = jax.lax.fori_loop(0, n_dev, ring_step, (counts, ring))
         return jax.lax.all_gather(counts, "x", axis=0, tiled=True)
 
-    fn = jax.jit(
-        shard_map_no_check(
-            per_device,
-            mesh=mesh,
-            in_specs=(pod_sharded_in_specs(tensors),),
-            out_specs=P(),
-        )
+    return _run_mesh_counts(
+        per_device, mesh, pod_sharded_in_specs(tensors), tensors, q, n_pods
     )
-    partials = np.asarray(fn(tensors), dtype=np.int64)
-    counts = partials.sum(axis=0)
-    return {
-        "ingress": int(counts[0]),
-        "egress": int(counts[1]),
-        "combined": int(counts[2]),
-        "cells": q * n_pods * n_pods,
-    }
 
 
 def evaluate_grid_counts_sharded(
@@ -413,14 +429,9 @@ def evaluate_grid_counts_sharded(
 
     The per-pod precompute (selector matches, tallow) is evaluated
     replicated — it is O(N), negligible next to the O(N^2) tile loop."""
-    from .sharded import _pad_pod_arrays, default_mesh, shard_map_no_check
-
-    mesh = mesh or default_mesh()
-    n_dev = mesh.devices.size
-    q = int(tensors["q_port"].shape[0])
-    block = _int32_safe_block(min(block, max(n_pods // n_dev, 1)), n_pods, q)
-    # pad so every device gets the same whole number of tiles
-    tensors, n_padded = _pad_pod_arrays(tensors, n_pods, n_dev * block)
+    mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
+        tensors, n_pods, block, mesh
+    )
     tiles_per_dev = n_padded // (n_dev * block)
 
     def per_device(t):
@@ -448,19 +459,7 @@ def evaluate_grid_counts_sharded(
     from jax.sharding import PartitionSpec as P
 
     in_specs = jax.tree_util.tree_map(lambda _: P(), tensors)
-    fn = jax.jit(
-        shard_map_no_check(
-            per_device, mesh=mesh, in_specs=(in_specs,), out_specs=P()
-        )
-    )
-    partials = np.asarray(fn(tensors), dtype=np.int64)
-    counts = partials.sum(axis=0)
-    return {
-        "ingress": int(counts[0]),
-        "egress": int(counts[1]),
-        "combined": int(counts[2]),
-        "cells": q * n_pods * n_pods,
-    }
+    return _run_mesh_counts(per_device, mesh, in_specs, tensors, q, n_pods)
 
 
 @jax.jit

@@ -151,6 +151,7 @@ class MockKubernetes(IKubernetes):
         self.namespaces: Dict[str, MockNamespace] = {}
         self.pass_rate = pass_rate
         self._pod_id = 1
+        self._service_id = 0
         self._rng = random.Random(seed)
         # bumped on every netpol mutation; lets policy-aware exec hooks
         # cache their compiled policy (see kube.mockcni)
@@ -229,6 +230,13 @@ class MockKubernetes(IKubernetes):
         if service.name in ns.services:
             raise KubeError(
                 f"service {service.namespace}/{service.name} already present"
+            )
+        if not service.cluster_ip:
+            # a real apiserver allocates a ClusterIP; without one the
+            # probe's service-ip destination mode targets an empty host
+            self._service_id += 1
+            service.cluster_ip = (
+                f"10.96.{self._service_id // 256}.{self._service_id % 256}"
             )
         ns.services[service.name] = service
         return service

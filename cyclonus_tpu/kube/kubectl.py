@@ -99,6 +99,12 @@ class KubectlKubernetes(IKubernetes):
         d = self._get_json(["get", "networkpolicy", "-n", namespace])
         return [parse_policy_dict(item) for item in d.get("items", [])]
 
+    def get_network_policies_all_namespaces(self) -> List[NetworkPolicy]:
+        """analyze --all-namespaces (reference analyze.go AllNamespaces /
+        kubectl -A)."""
+        d = self._get_json(["get", "networkpolicy", "--all-namespaces"])
+        return [parse_policy_dict(item) for item in d.get("items", [])]
+
     def update_network_policy(self, policy: NetworkPolicy) -> NetworkPolicy:
         self._apply(policy_to_dict(policy))
         return policy

@@ -3,6 +3,7 @@ into the probe layer where it belongs)."""
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Optional
 
@@ -38,6 +39,10 @@ class ProbeConfig:
     all_available: bool = False
     port_protocol: Optional[PortProtocol] = None
     mode: ProbeMode = PROBE_MODE_SERVICE_NAME
+
+    def with_mode(self, mode: ProbeMode) -> "ProbeConfig":
+        """Copy with the probe mode replaced (generate --destination-type)."""
+        return dataclasses.replace(self, mode=mode)
 
     @staticmethod
     def all_available_config(mode: ProbeMode = PROBE_MODE_SERVICE_NAME) -> "ProbeConfig":

@@ -39,7 +39,9 @@ class TestResources:
         assert all(p.ip.startswith("192.168.") for p in r.pods)
         # 2 ports x 3 protocols = 6 containers per pod
         assert all(len(p.containers) == 6 for p in r.pods)
-        assert r.pods[0].service_ip == ""  # mock services have no cluster ip
+        # the mock allocates ClusterIPs like a real apiserver so the
+        # service-ip probe destination mode works clusterless
+        assert r.pods[0].service_ip.startswith("10.96.")
 
     def test_immutable_updates(self):
         # resources_test.go:immutability specs
