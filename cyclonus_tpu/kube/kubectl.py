@@ -14,6 +14,7 @@ import shutil
 import subprocess
 from typing import Dict, List, Optional, Tuple
 
+from ..images import AGNHOST_IMAGE
 from .ikubernetes import IKubernetes, KubeError
 from .netpol import NetworkPolicy
 from .objects import (
@@ -228,7 +229,7 @@ def _container_manifest(c: KubeContainer) -> dict:
     manifest: dict = {
         "name": c.name,
         "imagePullPolicy": "IfNotPresent",
-        "image": c.image or "k8s.gcr.io/e2e-test-images/agnhost:2.28",
+        "image": c.image or AGNHOST_IMAGE,
         "securityContext": {},
     }
     if port is not None:

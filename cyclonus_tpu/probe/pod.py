@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field, replace
 from typing import Dict, List
 
@@ -21,8 +22,7 @@ from .probeconfig import (
     ProbeMode,
 )
 
-AGNHOST_IMAGE = "k8s.gcr.io/e2e-test-images/agnhost:2.28"
-WORKER_IMAGE = "cyclonus-tpu-worker:latest"
+from ..images import AGNHOST_IMAGE, WORKER_IMAGE  # noqa: F401  (re-export)
 
 
 @dataclass

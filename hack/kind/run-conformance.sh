@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# End-to-end CNI conformance run against a local KinD cluster
+# (reference flow: hack/kind/run-cyclonus.sh — create cluster, preload the
+# agnhost probe image, run the conformance generator from source).
+#
+# Usage:
+#   CNI=calico ./hack/kind/run-conformance.sh
+#   ARGS="generate --include conflict --batch-jobs" ./hack/kind/run-conformance.sh
+#
+# Requires: kind, kubectl, docker, python (with this repo importable).
+set -euo pipefail
+
+CNI=${CNI:-default}
+CLUSTER_NAME=${CLUSTER_NAME:-"netpol-$CNI"}
+AGNHOST_IMAGE=${AGNHOST_IMAGE:-${CYCLONUS_AGNHOST_IMAGE:-registry.k8s.io/e2e-test-images/agnhost:2.28}}
+WORKER_IMAGE=${WORKER_IMAGE:-${CYCLONUS_WORKER_IMAGE:-cyclonus-tpu-worker:latest}}
+ARGS=${ARGS:-"generate --include conflict"}
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+
+if ! command -v kind >/dev/null; then
+  echo "kind not found — install from https://kind.sigs.k8s.io" >&2
+  exit 1
+fi
+
+if ! kind get clusters | grep -qx "$CLUSTER_NAME"; then
+  if [ -f "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml" ]; then
+    kind create cluster --name "$CLUSTER_NAME" \
+      --config "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml"
+  elif [ "$CNI" = "default" ]; then
+    kind create cluster --name "$CLUSTER_NAME"
+  else
+    # a named CNI without a config would silently test kindnet instead
+    echo "no hack/kind/$CNI/kind-config.yaml — refusing to create a" \
+         "default-CNI cluster under the name netpol-$CNI" >&2
+    exit 1
+  fi
+fi
+
+# preload the probe image so pod creation doesn't wait on pulls
+docker pull "$AGNHOST_IMAGE"
+kind load docker-image "$AGNHOST_IMAGE" --name "$CLUSTER_NAME"
+
+# --batch-jobs runs probes via the in-pod worker image: build + preload it
+case " $ARGS " in *" --batch-jobs "*)
+  docker build -t "$WORKER_IMAGE" "$REPO_ROOT"
+  kind load docker-image "$WORKER_IMAGE" --name "$CLUSTER_NAME"
+  ;;
+esac
+
+kind export kubeconfig --name "$CLUSTER_NAME"
+kubectl get nodes
+kubectl get pods -A
+
+# the Python side reads the CYCLONUS_* names (cyclonus_tpu/images.py) —
+# keep it on exactly the images preloaded above
+export CYCLONUS_AGNHOST_IMAGE="$AGNHOST_IMAGE"
+export CYCLONUS_WORKER_IMAGE="$WORKER_IMAGE"
+
+# shellcheck disable=SC2086  # intentional word splitting of ARGS
+(cd "$REPO_ROOT" && python -m cyclonus_tpu $ARGS)
