@@ -62,7 +62,7 @@ def setup_analyze(sub) -> None:
     cmd.add_argument(
         "--engine",
         default="tpu",
-        choices=["oracle", "tpu", "native"],
+        choices=["oracle", "tpu", "tpu-sharded", "native"],
         help="simulated engine for probe mode",
     )
     cmd.set_defaults(func=run_analyze)

@@ -65,7 +65,7 @@ def setup_generate(sub) -> None:
     cmd.add_argument("--ignore-loopback", action="store_true", help="ignore loopback calls")
     cmd.add_argument("--noisy", action="store_true", help="print tables for every step")
     cmd.add_argument(
-        "--engine", default="tpu", choices=["oracle", "tpu", "native"], help="simulated engine"
+        "--engine", default="tpu", choices=["oracle", "tpu", "tpu-sharded", "native"], help="simulated engine"
     )
     cmd.add_argument(
         "--allow-dns",

@@ -53,7 +53,7 @@ def setup_probe(sub) -> None:
         "--probe-mode", default=PROBE_MODE_SERVICE_NAME, choices=[str(m) for m in ALL_PROBE_MODES]
     )
     cmd.add_argument(
-        "--engine", default="tpu", choices=["oracle", "tpu", "native"], help="simulated engine"
+        "--engine", default="tpu", choices=["oracle", "tpu", "tpu-sharded", "native"], help="simulated engine"
     )
     cmd.add_argument(
         "--pod-creation-timeout-seconds", type=int, default=60, help="pod creation timeout"

@@ -10,7 +10,7 @@ def setup_recipes(sub) -> None:
     cmd.add_argument(
         "--engine",
         default="tpu",
-        choices=["oracle", "tpu", "native"],
+        choices=["oracle", "tpu", "tpu-sharded", "native"],
         help="simulated engine",
     )
     cmd.set_defaults(func=_run)

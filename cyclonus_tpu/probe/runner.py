@@ -91,6 +91,8 @@ class SimulatedJobRunner(JobRunner):
     when the native library is unavailable or the shape unsupported)."""
 
     def __init__(self, policies: Policy, engine: str = DEFAULT_ENGINE, sharded: bool = False):
+        if engine == "tpu-sharded":  # CLI alias for engine=tpu + mesh
+            engine, sharded = "tpu", True
         if engine not in ("oracle", "tpu", "native"):
             raise ValueError(f"invalid simulated engine {engine!r}")
         self.policies = policies

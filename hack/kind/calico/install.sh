@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Install Calico into the kind cluster created with disableDefaultCNI
+# (called by ../run-conformance.sh with the cluster name as $1).
+set -euo pipefail
+
+CLUSTER_NAME=${1:?cluster name required}
+CALICO_VERSION=${CALICO_VERSION:-v3.27.3}
+
+kind export kubeconfig --name "$CLUSTER_NAME"
+kubectl apply -f \
+  "https://raw.githubusercontent.com/projectcalico/calico/${CALICO_VERSION}/manifests/calico.yaml"
+kubectl -n kube-system rollout status daemonset/calico-node --timeout=300s
+kubectl wait --for=condition=Ready nodes --all --timeout=300s

@@ -12,10 +12,15 @@ set -euo pipefail
 
 CNI=${CNI:-default}
 CLUSTER_NAME=${CLUSTER_NAME:-"netpol-$CNI"}
-AGNHOST_IMAGE=${AGNHOST_IMAGE:-${CYCLONUS_AGNHOST_IMAGE:-registry.k8s.io/e2e-test-images/agnhost:2.28}}
-WORKER_IMAGE=${WORKER_IMAGE:-${CYCLONUS_WORKER_IMAGE:-cyclonus-tpu-worker:latest}}
 ARGS=${ARGS:-"generate --include conflict"}
 REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+
+# image defaults come from cyclonus_tpu/images.py (the single source of
+# truth); AGNHOST_IMAGE / WORKER_IMAGE env vars override both sides
+AGNHOST_IMAGE=${AGNHOST_IMAGE:-$(cd "$REPO_ROOT" && python -c \
+  'from cyclonus_tpu.images import AGNHOST_IMAGE; print(AGNHOST_IMAGE)')}
+WORKER_IMAGE=${WORKER_IMAGE:-$(cd "$REPO_ROOT" && python -c \
+  'from cyclonus_tpu.images import WORKER_IMAGE; print(WORKER_IMAGE)')}
 
 if ! command -v kind >/dev/null; then
   echo "kind not found — install from https://kind.sigs.k8s.io" >&2
@@ -34,10 +39,20 @@ if ! kind get clusters | grep -qx "$CLUSTER_NAME"; then
          "default-CNI cluster under the name netpol-$CNI" >&2
     exit 1
   fi
+  # non-default CNIs disable kindnet; install the CNI before anything
+  # can schedule (reference flow: per-CNI setup-kind.sh)
+  if [ -x "$REPO_ROOT/hack/kind/$CNI/install.sh" ]; then
+    "$REPO_ROOT/hack/kind/$CNI/install.sh" "$CLUSTER_NAME"
+  elif [ "$CNI" != "default" ]; then
+    echo "no hack/kind/$CNI/install.sh — cluster has no CNI and nodes" \
+         "will stay NotReady" >&2
+    exit 1
+  fi
 fi
 
 # preload the probe image so pod creation doesn't wait on pulls
-docker pull "$AGNHOST_IMAGE"
+# (skip the pull for locally built images absent from any registry)
+docker image inspect "$AGNHOST_IMAGE" >/dev/null 2>&1 || docker pull "$AGNHOST_IMAGE"
 kind load docker-image "$AGNHOST_IMAGE" --name "$CLUSTER_NAME"
 
 # --batch-jobs runs probes via the in-pod worker image: build + preload it
