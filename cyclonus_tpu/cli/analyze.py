@@ -13,6 +13,7 @@ from ..matcher.builder import build_network_policies
 from ..matcher.core import Policy, Traffic, combine_targets_ignoring_primary_key
 from ..matcher.explain import explain_table
 from ..utils.table import render_table
+from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
 
 ALL_MODES = ["parse", "explain", "lint", "query-target", "query-traffic", "probe"]
 
@@ -61,8 +62,8 @@ def setup_analyze(sub) -> None:
     cmd.add_argument("--probe-path", default="", help="json synthetic probe model")
     cmd.add_argument(
         "--engine",
-        default="tpu",
-        choices=["oracle", "tpu", "tpu-sharded", "native"],
+        default=DEFAULT_ENGINE,
+        choices=ENGINE_CHOICES,
         help="simulated engine for probe mode",
     )
     cmd.set_defaults(func=run_analyze)

@@ -11,6 +11,7 @@ from ..generator.tags import validate_tags
 from ..kube.ikubernetes import IKubernetes, MockKubernetes
 from ..probe.probeconfig import ALL_PROBE_MODES, ProbeMode
 from ..probe.resources import Resources
+from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
 
 
 def setup_generate(sub) -> None:
@@ -65,7 +66,7 @@ def setup_generate(sub) -> None:
     cmd.add_argument("--ignore-loopback", action="store_true", help="ignore loopback calls")
     cmd.add_argument("--noisy", action="store_true", help="print tables for every step")
     cmd.add_argument(
-        "--engine", default="tpu", choices=["oracle", "tpu", "tpu-sharded", "native"], help="simulated engine"
+        "--engine", default=DEFAULT_ENGINE, choices=ENGINE_CHOICES, help="simulated engine"
     )
     cmd.add_argument(
         "--allow-dns",

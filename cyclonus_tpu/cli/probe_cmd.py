@@ -17,6 +17,7 @@ from ..probe.probeconfig import (
     ProbeMode,
 )
 from ..probe.resources import Resources
+from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
 
 
 def setup_probe(sub) -> None:
@@ -53,7 +54,7 @@ def setup_probe(sub) -> None:
         "--probe-mode", default=PROBE_MODE_SERVICE_NAME, choices=[str(m) for m in ALL_PROBE_MODES]
     )
     cmd.add_argument(
-        "--engine", default="tpu", choices=["oracle", "tpu", "tpu-sharded", "native"], help="simulated engine"
+        "--engine", default=DEFAULT_ENGINE, choices=ENGINE_CHOICES, help="simulated engine"
     )
     cmd.add_argument(
         "--pod-creation-timeout-seconds", type=int, default=60, help="pod creation timeout"

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
+
 
 def setup_recipes(sub) -> None:
     cmd = sub.add_parser(
@@ -9,8 +11,8 @@ def setup_recipes(sub) -> None:
     )
     cmd.add_argument(
         "--engine",
-        default="tpu",
-        choices=["oracle", "tpu", "tpu-sharded", "native"],
+        default=DEFAULT_ENGINE,
+        choices=ENGINE_CHOICES,
         help="simulated engine",
     )
     cmd.set_defaults(func=_run)

@@ -31,6 +31,8 @@ from .resources import Resources
 from .table import Table
 
 DEFAULT_ENGINE = "tpu"
+# the CLI --engine vocabulary (tpu-sharded = tpu over the device mesh)
+ENGINE_CHOICES = ["oracle", "tpu", "tpu-sharded", "native"]
 
 
 class JobRunner:

@@ -17,37 +17,44 @@ REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
 
 # image defaults come from cyclonus_tpu/images.py (the single source of
 # truth); AGNHOST_IMAGE / WORKER_IMAGE env vars override both sides
-AGNHOST_IMAGE=${AGNHOST_IMAGE:-$(cd "$REPO_ROOT" && python -c \
-  'from cyclonus_tpu.images import AGNHOST_IMAGE; print(AGNHOST_IMAGE)')}
-WORKER_IMAGE=${WORKER_IMAGE:-$(cd "$REPO_ROOT" && python -c \
-  'from cyclonus_tpu.images import WORKER_IMAGE; print(WORKER_IMAGE)')}
+{ read -r DEFAULT_AGNHOST; read -r DEFAULT_WORKER; } < <(
+  cd "$REPO_ROOT" && python -c \
+    'from cyclonus_tpu import images; print(images.AGNHOST_IMAGE); print(images.WORKER_IMAGE)'
+)
+AGNHOST_IMAGE=${AGNHOST_IMAGE:-$DEFAULT_AGNHOST}
+WORKER_IMAGE=${WORKER_IMAGE:-$DEFAULT_WORKER}
 
 if ! command -v kind >/dev/null; then
   echo "kind not found — install from https://kind.sigs.k8s.io" >&2
   exit 1
 fi
 
+# a named CNI needs BOTH its kind config and an installer; check before
+# any cluster exists so a half-provisioned rerun can't sail past
+if [ "$CNI" != "default" ]; then
+  if [ ! -f "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml" ] ||
+     [ ! -x "$REPO_ROOT/hack/kind/$CNI/install.sh" ]; then
+    echo "hack/kind/$CNI/ must provide kind-config.yaml and an executable" \
+         "install.sh (a disableDefaultCNI cluster without them tests the" \
+         "wrong CNI or stays NotReady)" >&2
+    exit 1
+  fi
+fi
+
 if ! kind get clusters | grep -qx "$CLUSTER_NAME"; then
-  if [ -f "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml" ]; then
-    kind create cluster --name "$CLUSTER_NAME" \
-      --config "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml"
-  elif [ "$CNI" = "default" ]; then
+  if [ "$CNI" = "default" ]; then
     kind create cluster --name "$CLUSTER_NAME"
   else
-    # a named CNI without a config would silently test kindnet instead
-    echo "no hack/kind/$CNI/kind-config.yaml — refusing to create a" \
-         "default-CNI cluster under the name netpol-$CNI" >&2
-    exit 1
+    kind create cluster --name "$CLUSTER_NAME" \
+      --config "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml"
   fi
-  # non-default CNIs disable kindnet; install the CNI before anything
-  # can schedule (reference flow: per-CNI setup-kind.sh)
-  if [ -x "$REPO_ROOT/hack/kind/$CNI/install.sh" ]; then
-    "$REPO_ROOT/hack/kind/$CNI/install.sh" "$CLUSTER_NAME"
-  elif [ "$CNI" != "default" ]; then
-    echo "no hack/kind/$CNI/install.sh — cluster has no CNI and nodes" \
-         "will stay NotReady" >&2
-    exit 1
-  fi
+fi
+
+# install (or re-assert) the CNI OUTSIDE the creation branch: installers
+# are idempotent kubectl-applies, so a rerun after a failed install still
+# converges instead of skipping straight to a NotReady cluster
+if [ "$CNI" != "default" ]; then
+  "$REPO_ROOT/hack/kind/$CNI/install.sh" "$CLUSTER_NAME"
 fi
 
 # preload the probe image so pod creation doesn't wait on pulls

@@ -1,0 +1,140 @@
+"""Worker subsystem tests (reference leaves pkg/worker untested — SURVEY
+§4 "What's NOT tested"; here the wire model, the in-pod prober loop, the
+driver-side client, and the CLI entry all get coverage)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cyclonus_tpu.worker.client import Client
+from cyclonus_tpu.worker.model import Batch, Request, Result
+from cyclonus_tpu.worker import worker as worker_mod
+from cyclonus_tpu.worker.worker import issue_batch, run_worker
+from cyclonus_tpu.kube.ikubernetes import KubeError
+
+
+def make_batch(n=2):
+    return Batch(
+        namespace="x",
+        pod="a",
+        container="cont-80-tcp",
+        requests=[
+            Request(key=f"k{i}", protocol="tcp", host="192.168.1.2", port=80 + i)
+            for i in range(n)
+        ],
+    )
+
+
+class TestModel:
+    def test_batch_json_roundtrip(self):
+        b = make_batch()
+        b2 = Batch.from_json(b.to_json())
+        assert b2 == b
+        assert b2.key() == "x/a/cont-80-tcp"
+
+    def test_result_roundtrip(self):
+        r = Result(request=make_batch().requests[0], output="ok", error="")
+        assert Result.from_dict(r.to_dict()) == r
+        assert r.is_success()
+        assert not Result(request=r.request, error="boom").is_success()
+
+    def test_request_command_shape(self):
+        cmd = Request(key="k", protocol="tcp", host="h", port=80).command()
+        assert cmd[0] == "/agnhost" and "h:80" in cmd
+        assert any(a.startswith("--protocol=") for a in cmd)
+
+    def test_request_invalid_protocol(self):
+        with pytest.raises(ValueError):
+            Request(key="k", protocol="icmp", host="h", port=80).command()
+
+
+class _FakeProc:
+    def __init__(self, returncode=0, stdout="CONNECTED", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+class TestWorkerLoop:
+    def test_run_worker_success(self, monkeypatch):
+        monkeypatch.setattr(
+            worker_mod.subprocess, "run", lambda *a, **k: _FakeProc()
+        )
+        out = run_worker(make_batch().to_json())
+        parsed = [Result.from_dict(d) for d in json.loads(out)]
+        assert len(parsed) == 2 and all(r.is_success() for r in parsed)
+
+    def test_run_worker_failure_records_error(self, monkeypatch):
+        monkeypatch.setattr(
+            worker_mod.subprocess,
+            "run",
+            lambda *a, **k: _FakeProc(returncode=1, stderr="REFUSED"),
+        )
+        results = issue_batch(make_batch(1))
+        assert results[0].error == "REFUSED"
+
+    def test_run_worker_timeout_records_error(self, monkeypatch):
+        def boom(*a, **k):
+            raise subprocess.TimeoutExpired(cmd=a[0], timeout=5)
+
+        monkeypatch.setattr(worker_mod.subprocess, "run", boom)
+        results = issue_batch(make_batch(1))
+        assert results[0].error == "timeout"
+
+    def test_empty_batch(self):
+        assert issue_batch(Batch(namespace="x", pod="a", container="c")) == []
+
+
+class _StubKube:
+    """IKubernetes stub: returns a canned exec result."""
+
+    def __init__(self, stdout="", stderr="", err=None):
+        self._ret = (stdout, stderr, err)
+        self.calls = []
+
+    def execute_remote_command(self, namespace, pod, container, command):
+        self.calls.append((namespace, pod, container, command))
+        return self._ret
+
+
+class TestClient:
+    def test_batch_roundtrip(self):
+        batch = make_batch(1)
+        results = [Result(request=batch.requests[0], output="ok")]
+        kube = _StubKube(stdout=json.dumps([r.to_dict() for r in results]))
+        got = Client(kube).batch(batch)
+        assert got == results
+        # the exec'd command is the in-pod worker invocation
+        (_, _, _, command), = kube.calls
+        assert command[0] == "/worker" and command[1] == "--jobs"
+        assert Batch.from_json(command[2]) == batch
+
+    def test_batch_exec_error(self):
+        kube = _StubKube(err=KubeError("exec failed"))
+        with pytest.raises(KubeError):
+            Client(kube).batch(make_batch(1))
+
+    def test_batch_bad_json(self):
+        kube = _StubKube(stdout="not-json{")
+        with pytest.raises(KubeError):
+            Client(kube).batch(make_batch(1))
+
+
+class TestCLI:
+    def test_main_empty_batch(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "cyclonus_tpu.worker",
+                "--jobs",
+                '{"Namespace":"x","Pod":"a","Container":"c","Requests":[]}',
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout) == []
