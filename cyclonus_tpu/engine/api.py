@@ -151,6 +151,11 @@ class TpuPolicyEngine:
         pods: Sequence[Tuple[str, str, Dict[str, str], str]],
         namespaces: Dict[str, Dict[str, str]],
     ):
+        # every evaluation path below is jax-backed: first-touch setup of
+        # the persistent compile cache happens here, not at import time
+        from . import ensure_persistent_compile_cache
+
+        ensure_persistent_compile_cache()
         with phase("engine.encode"):
             self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
             self._tensors = self._build_tensors()

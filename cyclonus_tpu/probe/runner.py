@@ -95,7 +95,7 @@ class SimulatedJobRunner(JobRunner):
     def __init__(self, policies: Policy, engine: str = DEFAULT_ENGINE, sharded: bool = False):
         if engine == "tpu-sharded":  # CLI alias for engine=tpu + mesh
             engine, sharded = "tpu", True
-        if engine not in ("oracle", "tpu", "native"):
+        if engine not in set(ENGINE_CHOICES) - {"tpu-sharded"}:
             raise ValueError(f"invalid simulated engine {engine!r}")
         self.policies = policies
         self.engine = engine

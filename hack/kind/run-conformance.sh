@@ -16,13 +16,16 @@ ARGS=${ARGS:-"generate --include conflict"}
 REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
 
 # image defaults come from cyclonus_tpu/images.py (the single source of
-# truth); AGNHOST_IMAGE / WORKER_IMAGE env vars override both sides
-{ read -r DEFAULT_AGNHOST; read -r DEFAULT_WORKER; } < <(
-  cd "$REPO_ROOT" && python -c \
-    'from cyclonus_tpu import images; print(images.AGNHOST_IMAGE); print(images.WORKER_IMAGE)'
-)
-AGNHOST_IMAGE=${AGNHOST_IMAGE:-$DEFAULT_AGNHOST}
-WORKER_IMAGE=${WORKER_IMAGE:-$DEFAULT_WORKER}
+# truth); AGNHOST_IMAGE / WORKER_IMAGE env vars override both sides, and
+# setting both skips the python query entirely
+if [ -z "${AGNHOST_IMAGE:-}" ] || [ -z "${WORKER_IMAGE:-}" ]; then
+  { read -r DEFAULT_AGNHOST; read -r DEFAULT_WORKER; } < <(
+    cd "$REPO_ROOT" && python -c \
+      'from cyclonus_tpu import images; print(images.AGNHOST_IMAGE); print(images.WORKER_IMAGE)'
+  )
+  AGNHOST_IMAGE=${AGNHOST_IMAGE:-$DEFAULT_AGNHOST}
+  WORKER_IMAGE=${WORKER_IMAGE:-$DEFAULT_WORKER}
+fi
 
 if ! command -v kind >/dev/null; then
   echo "kind not found — install from https://kind.sigs.k8s.io" >&2
