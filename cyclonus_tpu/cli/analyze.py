@@ -6,7 +6,7 @@ from __future__ import annotations
 import json
 from typing import List
 
-from ..kube.labels import label_selector_table_lines
+from ..kube.labels import label_selector_table_lines, serialize_label_selector
 from ..kube.netpol import IntOrString, LabelSelector, NetworkPolicy
 from ..kube.yaml_io import load_policies_from_path
 from ..matcher.builder import build_network_policies
@@ -127,21 +127,76 @@ def run_analyze(args) -> int:
     return 0
 
 
+def _print_peers(peers) -> str:
+    """networkpolicy.go:51-64."""
+    if not peers:
+        return "all peers"
+    lines = []
+    for peer in peers:
+        if peer.ip_block is not None:
+            lines.append(
+                f"{peer.ip_block.cidr} except "
+                f"[{','.join(peer.ip_block.except_)}]"
+            )
+        else:
+            ns = (
+                "nil"
+                if peer.namespace_selector is None
+                else serialize_label_selector(peer.namespace_selector)
+            )
+            pod = (
+                "nil"
+                if peer.pod_selector is None
+                else serialize_label_selector(peer.pod_selector)
+            )
+            lines.append(f"ns/pod selector:\n - ns: {ns}\n - pod: {pod}")
+    return "\n\n".join(lines)
+
+
+def _print_ports(ports) -> str:
+    """networkpolicy.go:85-110."""
+    if not ports:
+        return "all ports, all protocols"
+    lines = []
+    for pp in ports:
+        port = "all ports" if pp.port is None else f"port {pp.port.value}"
+        protocol = pp.protocol or "TCP"
+        if pp.end_port is None:
+            lines.append(f"{port} on {protocol}")
+        else:
+            # endPort without port is invalid per k8s validation but must
+            # not crash the inspection tool
+            lo = pp.port.value if pp.port is not None else "nil"
+            lines.append(f"[{lo}, {pp.end_port}] on {protocol}")
+    return "\n".join(lines)
+
+
 def _parse_table(policies: List[NetworkPolicy]) -> str:
-    """kube/networkpolicy.go:11-49 equivalent summary."""
+    """Per-rule policy table (networkpolicy.go:11-49): one row per
+    ingress/egress rule with its peers and ports spelled out."""
     rows = []
     for p in policies:
-        rows.append(
-            [
-                f"{p.effective_namespace()}/{p.name}",
-                ", ".join(p.spec.policy_types),
-                label_selector_table_lines(p.spec.pod_selector),
-                str(len(p.spec.ingress)),
-                str(len(p.spec.egress)),
-            ]
-        )
+        name = f"{p.effective_namespace()}/{p.name}"
+        target = label_selector_table_lines(p.spec.pod_selector)
+        for policy_type in p.spec.policy_types:
+            if policy_type == "Ingress":
+                if not p.spec.ingress:
+                    rows.append([name, target, "ingress", "none", "none"])
+                for rule in p.spec.ingress:
+                    rows.append(
+                        [name, target, "ingress",
+                         _print_peers(rule.from_), _print_ports(rule.ports)]
+                    )
+            elif policy_type == "Egress":
+                if not p.spec.egress:
+                    rows.append([name, target, "egress", "none", "none"])
+                for rule in p.spec.egress:
+                    rows.append(
+                        [name, target, "egress",
+                         _print_peers(rule.to), _print_ports(rule.ports)]
+                    )
     return render_table(
-        ["Policy", "Types", "Pod selector", "Ingress rules", "Egress rules"],
+        ["Policy", "Target", "Direction", "Peer", "Port/Protocol"],
         rows,
         row_line=True,
     )
