@@ -50,83 +50,99 @@ BD = 512
 KT = 1024
 
 
-def _verdict_counts_kernel(
-    a_e_ref,  # [BS, KT] bf16   tmatch_e^T src block, T-chunk k
-    b_e_ref,  # [1, KT, BD] bf16  tallow_e (q, T-chunk k, dst block j)
-    b_i_ref,  # [1, KT, BS] bf16  tallow_i (q, T-chunk k, src block i)
-    a_i_ref,  # [KT, BD] bf16   tmatch_i (T-chunk k, dst block j)
-    has_e_ref,  # [1, BS] int32  src block i
-    has_i_ref,  # [1, BD] int32  dst block j
-    valid_s_ref,  # [1, BS] int32
-    valid_d_ref,  # [1, BD] int32
-    counts_ref,  # [1, n_i, 128] int32: per-q count plane, row per src-tile
-    acc_e_ref,  # [BS, BD] f32 scratch
-    acc_i_ref,  # [BS, BD] f32 scratch
-    cnt_ref,  # [1, 128] int32 scratch: running counts for this (q, i)
-):
-    i = pl.program_id(1)
-    j = pl.program_id(2)
-    k = pl.program_id(3)
-    n_j = pl.num_programs(2)
-    n_k = pl.num_programs(3)
+def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
+    """Kernel body specialized on the per-direction T-chunk counts: the
+    two directions usually pad to different target-axis lengths (egress
+    targets are a subset of policies), and multiplying the shorter
+    direction's zero chunks would waste up to ~⅓ of the MXU work."""
 
-    # counts accumulate into a per-(q, src-tile) ROW of the per-q count
-    # plane: a single global accumulator overflows int32 once allowed
-    # cells exceed 2^31 (seen at 100k pods); per-row partials are bounded
-    # by BS * N < 2^31.  (The plane is the output block — a (1, 1, 128)
-    # block would violate the Mosaic (8, 128) tiling rule for n_i > 1.)
-    @pl.when((i == 0) & (j == 0) & (k == 0))
-    def _init_counts():
-        counts_ref[:] = jnp.zeros_like(counts_ref)
+    def _verdict_counts_kernel(
+        a_e_ref,  # [BS, KT] bf16   tmatch_e^T src block, T-chunk k
+        b_e_ref,  # [1, KT, BD] bf16  tallow_e (q, T-chunk k, dst block j)
+        b_i_ref,  # [1, KT, BS] bf16  tallow_i (q, T-chunk k, src block i)
+        a_i_ref,  # [KT, BD] bf16   tmatch_i (T-chunk k, dst block j)
+        has_e_ref,  # [1, BS] int32  src block i
+        has_i_ref,  # [1, BD] int32  dst block j
+        valid_s_ref,  # [1, BS] int32
+        valid_d_ref,  # [1, BD] int32
+        counts_ref,  # [1, n_i, 128] int32: per-q count plane, row per src-tile
+        acc_e_ref,  # [BS, BD] f32 scratch
+        acc_i_ref,  # [BS, BD] f32 scratch
+        cnt_ref,  # [1, 128] int32 scratch: running counts for this (q, i)
+    ):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        k = pl.program_id(3)
+        n_j = pl.num_programs(2)
+        n_k = pl.num_programs(3)
 
-    @pl.when(k == 0)
-    def _init_acc():
-        acc_e_ref[:] = jnp.zeros_like(acc_e_ref)
-        acc_i_ref[:] = jnp.zeros_like(acc_i_ref)
+        # counts accumulate into a per-(q, src-tile) ROW of the per-q count
+        # plane: a single global accumulator overflows int32 once allowed
+        # cells exceed 2^31 (seen at 100k pods); per-row partials are bounded
+        # by BS * N < 2^31.  (The plane is the output block — a (1, 1, 128)
+        # block would violate the Mosaic (8, 128) tiling rule for n_i > 1.)
+        @pl.when((i == 0) & (j == 0) & (k == 0))
+        def _init_counts():
+            counts_ref[:] = jnp.zeros_like(counts_ref)
 
-    @pl.when((j == 0) & (k == 0))
-    def _init_cnt():
-        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+        @pl.when(k == 0)
+        def _init_acc():
+            acc_e_ref[:] = jnp.zeros_like(acc_e_ref)
+            acc_i_ref[:] = jnp.zeros_like(acc_i_ref)
 
-    # egress[b, d] += sum_t tmatch_e[t, src b] * tallow_e[t, dst d]
-    acc_e_ref[:] += jnp.dot(
-        a_e_ref[:], b_e_ref[0], preferred_element_type=jnp.float32
-    )
-    # ingress[b, d] += sum_t tallow_i[t, src b] * tmatch_i[t, dst d]
-    acc_i_ref[:] += jax.lax.dot_general(
-        b_i_ref[0],
-        a_i_ref[:],
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        @pl.when((j == 0) & (k == 0))
+        def _init_cnt():
+            cnt_ref[:] = jnp.zeros_like(cnt_ref)
 
-    @pl.when(k == n_k - 1)
-    def _epilogue():
-        # Mosaic can't reshape i1 vectors to 2D — route every row-direction
-        # broadcast through f32.  acc values are nonneg counts, so adding a
-        # huge constant where the pod has no target flips the > 0 verdict.
-        no_e = (has_e_ref[0, :] == 0).astype(jnp.float32)[:, None]  # [BS, 1]
-        no_i = (has_i_ref[0, :] == 0).astype(jnp.float32)  # [BD]
-        egress = (acc_e_ref[:] + no_e * 1e9) > 0.0
-        ingress = (acc_i_ref[:] + no_i[None, :] * 1e9) > 0.0
-        combined = egress & ingress
-        vs = valid_s_ref[0, :].astype(jnp.float32)[:, None]  # [BS, 1]
-        vd = valid_d_ref[0, :].astype(jnp.float32)  # [BD]
-        mask = (vs * vd[None, :]) > 0.0
-        c_in = jnp.sum((ingress & mask).astype(jnp.int32))
-        c_eg = jnp.sum((egress & mask).astype(jnp.int32))
-        c_co = jnp.sum((combined & mask).astype(jnp.int32))
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
-        cnt_ref[:] += (
-            jnp.where(lane == 0, c_in, 0)
-            + jnp.where(lane == 1, c_eg, 0)
-            + jnp.where(lane == 2, c_co, 0)
-        )
-        # flush to this (q, i)'s row of the count plane once per src-tile
-        # (the dynamic-row store is the expensive part)
-        @pl.when(j == n_j - 1)
-        def _flush():
-            counts_ref[:, pl.ds(i, 1), :] = cnt_ref[:].reshape(1, 1, 128)
+        # egress[b, d] += sum_t tmatch_e[t, src b] * tallow_e[t, dst d].
+        # Guarded per direction: for k >= n_k_dir the clamped index maps
+        # REFETCH the direction's last real chunk (not zeros), so the
+        # accumulate must be skipped, not relied on to be a no-op.
+        @pl.when(k < n_k_e)
+        def _acc_egress():
+            acc_e_ref[:] += jnp.dot(
+                a_e_ref[:], b_e_ref[0], preferred_element_type=jnp.float32
+            )
+
+        # ingress[b, d] += sum_t tallow_i[t, src b] * tmatch_i[t, dst d]
+        @pl.when(k < n_k_i)
+        def _acc_ingress():
+            acc_i_ref[:] += jax.lax.dot_general(
+                b_i_ref[0],
+                a_i_ref[:],
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(k == n_k - 1)
+        def _epilogue():
+            # Mosaic can't reshape i1 vectors to 2D — route every row-direction
+            # broadcast through f32.  acc values are nonneg counts, so adding a
+            # huge constant where the pod has no target flips the > 0 verdict.
+            no_e = (has_e_ref[0, :] == 0).astype(jnp.float32)[:, None]  # [BS, 1]
+            no_i = (has_i_ref[0, :] == 0).astype(jnp.float32)  # [BD]
+            egress = (acc_e_ref[:] + no_e * 1e9) > 0.0
+            ingress = (acc_i_ref[:] + no_i[None, :] * 1e9) > 0.0
+            combined = egress & ingress
+            vs = valid_s_ref[0, :].astype(jnp.float32)[:, None]  # [BS, 1]
+            vd = valid_d_ref[0, :].astype(jnp.float32)  # [BD]
+            mask = (vs * vd[None, :]) > 0.0
+            c_in = jnp.sum((ingress & mask).astype(jnp.int32))
+            c_eg = jnp.sum((egress & mask).astype(jnp.int32))
+            c_co = jnp.sum((combined & mask).astype(jnp.int32))
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+            cnt_ref[:] += (
+                jnp.where(lane == 0, c_in, 0)
+                + jnp.where(lane == 1, c_eg, 0)
+                + jnp.where(lane == 2, c_co, 0)
+            )
+            # flush to this (q, i)'s row of the count plane once per src-tile
+            # (the dynamic-row store is the expensive part)
+            @pl.when(j == n_j - 1)
+            def _flush():
+                counts_ref[:, pl.ds(i, 1), :] = cnt_ref[:].reshape(1, 1, 128)
+
+    return _verdict_counts_kernel
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
@@ -183,15 +199,13 @@ def verdict_counts_pallas(
     valid_d = _pad_to(valid[None, :], 1, nb)
 
     n_pad = a_e.shape[0]
-    kt_e = b_e.shape[1]
-    kt_i = b_i.shape[1]
-    # one T-chunk count for both directions: pad both to the max so the
-    # k grid dimension is shared (extra chunks are all-zero rows)
-    kt = max(kt_e, kt_i)
-    a_e = _pad_to(a_e, 1, kt)
-    b_e = _pad_to(b_e, 1, kt)
-    a_i = _pad_to(a_i, 0, kt)
-    b_i = _pad_to(b_i, 1, kt)
+    # the k grid dimension is shared, but each direction only has its OWN
+    # padded T-chunk count of real work: the kernel skips the other
+    # direction's matmul past its n_k (saving the MXU time), and the
+    # clamped index maps below keep the block fetch in bounds without
+    # padding the shorter direction up (saving the HBM space + DMA)
+    n_k_e = b_e.shape[1] // KT
+    n_k_i = b_i.shape[1] // KT
 
     n_i = n_pad // BS
     # per-(q, src-tile) partial counts stay within int32: BS * n_pad
@@ -199,15 +213,17 @@ def verdict_counts_pallas(
     assert BS * n_pad < 2**31, (
         f"pod axis {n_pad} too large for int32 tile counts at BS={BS}"
     )
-    grid = (q, n_i, n_pad // BD, kt // KT)
+    grid = (q, n_i, n_pad // BD, max(n_k_e, n_k_i))
+    clamp_e = lambda k: jnp.minimum(k, n_k_e - 1)
+    clamp_i = lambda k: jnp.minimum(k, n_k_i - 1)
     counts = pl.pallas_call(
-        _verdict_counts_kernel,
+        _make_verdict_counts_kernel(n_k_e, n_k_i),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((BS, KT), lambda q, i, j, k: (i, k), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, KT, BD), lambda q, i, j, k: (q, k, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, KT, BS), lambda q, i, j, k: (q, k, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((KT, BD), lambda q, i, j, k: (k, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BS, KT), lambda q, i, j, k: (i, clamp_e(k)), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, KT, BD), lambda q, i, j, k: (q, clamp_e(k), j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, KT, BS), lambda q, i, j, k: (q, clamp_i(k), i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((KT, BD), lambda q, i, j, k: (clamp_i(k), j), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, BS), lambda q, i, j, k: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, BD), lambda q, i, j, k: (0, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, BS), lambda q, i, j, k: (0, i), memory_space=pltpu.VMEM),
@@ -223,8 +239,8 @@ def verdict_counts_pallas(
             pltpu.VMEM((1, 128), jnp.int32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=2 * 2 * q * n_pad * n_pad * kt,
-            bytes_accessed=2 * q * (n_pad // BS) * n_pad * kt * 2,
+            flops=2 * q * n_pad * n_pad * (n_k_e + n_k_i) * KT,
+            bytes_accessed=2 * q * (n_pad // BS) * n_pad * (n_k_e + n_k_i) * KT,
             transcendentals=0,
         ),
         interpret=interpret,

@@ -39,6 +39,67 @@ class TestPallasCounts:
         b = engine.evaluate_grid_counts(CASES, backend="pallas")
         assert a == b
 
+    def test_unequal_direction_chunks(self, monkeypatch):
+        """Regression: with different target-axis chunk counts per
+        direction (n_k_e != n_k_i), the clamped index maps refetch the
+        shorter direction's last chunk and the per-direction guards must
+        skip accumulating it.  Shrinking KT forces multiple chunks from a
+        small fixture; an ingress-heavy and an egress-heavy policy set
+        exercise both orderings."""
+        import jax
+
+        import cyclonus_tpu.engine.pallas_kernel as pk
+        from cyclonus_tpu.kube.netpol import (
+            IntOrString,
+            LabelSelector,
+            NetworkPolicyEgressRule,
+            NetworkPolicyIngressRule,
+            NetworkPolicyPeer,
+            NetworkPolicyPort,
+        )
+        from cyclonus_tpu.matcher import build_network_policies
+        from test_engine_parity import default_cluster, mkpol
+
+        pods, namespaces = default_cluster()
+
+        def mk_dir_policies(n_ing, n_eg):
+            out = []
+            for i in range(n_ing):
+                out.append(mkpol(
+                    f"in{i}", "x",
+                    LabelSelector.make(match_labels={"pod": "abc"[i % 3], "i": str(i)}),
+                    ["Ingress"],
+                    ingress=[NetworkPolicyIngressRule(
+                        ports=[NetworkPolicyPort(protocol="TCP", port=IntOrString(80))],
+                        from_=[NetworkPolicyPeer(pod_selector=LabelSelector.make())],
+                    )],
+                ))
+            for i in range(n_eg):
+                out.append(mkpol(
+                    f"eg{i}", "y",
+                    LabelSelector.make(match_labels={"pod": "abc"[i % 3], "e": str(i)}),
+                    ["Egress"],
+                    egress=[NetworkPolicyEgressRule(
+                        ports=[],
+                        to=[NetworkPolicyPeer(pod_selector=LabelSelector.make())],
+                    )],
+                ))
+            return out
+
+        # KT is a lane dimension (min 128); >128 targets on one side
+        # yields n_k 2 vs 1
+        monkeypatch.setattr(pk, "KT", 128)
+        try:
+            for n_ing, n_eg in [(150, 3), (3, 150)]:
+                policy = build_network_policies(True, mk_dir_policies(n_ing, n_eg))
+                engine = TpuPolicyEngine(policy, pods, namespaces)
+                want = engine.evaluate_grid_counts(CASES, block=8, backend="xla")
+                jax.clear_caches()  # KT is read at trace time, not cached on
+                got = engine.evaluate_grid_counts(CASES, backend="pallas")
+                assert got == want, (n_ing, n_eg, got, want)
+        finally:
+            jax.clear_caches()
+
     def test_unequal_src_dst_tiles(self, monkeypatch):
         """Regression: with BS != BD the pod axis must pad to a COMMON
         multiple — independent rounding silently dropped trailing dst
