@@ -79,6 +79,23 @@ def pod_sharded_in_specs(tensors: Dict) -> Dict:
     return in_specs
 
 
+def mesh_device_context(mesh: Mesh):
+    """Context manager for dispatching onto `mesh`.  A CPU mesh (the
+    virtual multi-device fallback on a single-chip TPU host — see
+    default_mesh) pins every dispatch in the scope to CPU so no unsharded
+    op lands on the default device: a CPU-mesh evaluation must never
+    touch — or require a working — TPU.  Decided from the mesh platform
+    alone (querying the default backend would initialize it, which can
+    hang on a dead tunnel); when CPU already IS the default backend the
+    pin is a no-op."""
+    import contextlib
+
+    dev = mesh.devices.flat[0]
+    if dev.platform == "cpu":
+        return jax.default_device(dev)
+    return contextlib.nullcontext()
+
+
 def default_mesh() -> Mesh:
     """All devices of the default backend; when that's a single chip (e.g. a
     tunneled TPU) but the CPU backend exposes a virtual multi-device mesh
@@ -242,11 +259,12 @@ def evaluate_grid_sharded(
             _sharded_eval, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
         )
     )
-    ingress_rows, egress, combined = fn(tensors)
-    # stay on device: strip pad rows and fix the ingress layout
-    # ([src, dst, q] -> [dst, src, q]) with lazy jnp ops
-    ingress_rows = ingress_rows[:n_pods, :n_pods]
-    egress = egress[:n_pods, :n_pods]
-    combined = combined[:n_pods, :n_pods]
-    ingress = jnp.swapaxes(ingress_rows, 0, 1)
+    with mesh_device_context(mesh):
+        ingress_rows, egress, combined = fn(tensors)
+        # stay on device: strip pad rows and fix the ingress layout
+        # ([src, dst, q] -> [dst, src, q]) with lazy jnp ops
+        ingress_rows = ingress_rows[:n_pods, :n_pods]
+        egress = egress[:n_pods, :n_pods]
+        combined = combined[:n_pods, :n_pods]
+        ingress = jnp.swapaxes(ingress_rows, 0, 1)
     return ingress, egress, combined

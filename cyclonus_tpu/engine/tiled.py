@@ -328,14 +328,15 @@ def _run_mesh_counts(
     int64 silently truncates without jax_enable_x64)."""
     from jax.sharding import PartitionSpec as P
 
-    from .sharded import shard_map_no_check
+    from .sharded import mesh_device_context, shard_map_no_check
 
     fn = jax.jit(
         shard_map_no_check(
             per_device, mesh=mesh, in_specs=(in_specs,), out_specs=P()
         )
     )
-    counts = np.asarray(fn(tensors), dtype=np.int64).sum(axis=0)
+    with mesh_device_context(mesh):
+        counts = np.asarray(fn(tensors), dtype=np.int64).sum(axis=0)
     return {
         "ingress": int(counts[0]),
         "egress": int(counts[1]),
