@@ -1,7 +1,17 @@
 # Runtime image for the CLI + in-pod worker (reference:
 # cmd/cyclonus/Dockerfile builds an alpine image around a static binary;
 # the Python equivalent ships the package with a CPU jax).
+#
+# The worker pod serves with `/agnhost serve-hostname` and probes with
+# `/agnhost connect` (probe/runner.py batch mode, worker/model.py), so the
+# agnhost binary must exist in this image — the reference's worker image
+# is `FROM agnhost` for the same reason (cmd/worker/Dockerfile).
+# keep the default in sync with cyclonus_tpu/images.py AGNHOST_IMAGE
+ARG AGNHOST_IMAGE=registry.k8s.io/e2e-test-images/agnhost:2.28
+FROM ${AGNHOST_IMAGE} AS agnhost
+
 FROM python:3.12-slim
+COPY --from=agnhost /agnhost /agnhost
 
 # g++ lets native/build.py compile the C++ grid evaluator on demand
 # (--engine native); kubectl is NOT baked in — mount one for real-cluster

@@ -4,6 +4,15 @@
 test:
 	python -m pytest tests/ -q
 
+# the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
+# syntax-compile everything, then run the suite on a CPU 8-device mesh
+check: vet
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q
+
+# opt-in: the full 216-case conformance suite with a journal artifact
+conformance:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m conformance
+
 bench:
 	python bench.py
 
@@ -20,4 +29,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test bench fmt vet cyclonus docker
+.PHONY: test check conformance bench fmt vet cyclonus docker

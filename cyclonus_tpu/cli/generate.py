@@ -53,7 +53,8 @@ def setup_generate(sub) -> None:
         "--exclude",
         action="append",
         default=None,
-        help="tags to exclude (default: multi-peer, upstream-e2e, example)",
+        help="tags to exclude (default: multi-peer, upstream-e2e, example; "
+        "pass the literal value 'none' to run the full unfiltered suite)",
     )
     cmd.add_argument("--retries", type=int, default=1, help="kube probe retries")
     cmd.add_argument(
@@ -116,6 +117,15 @@ def run_generate(args) -> int:
     ports = args.server_port or [80, 81]
     protocols = [p.upper() for p in (args.server_protocol or ["TCP", "UDP", "SCTP"])]
     excluded = args.exclude if args.exclude is not None else DEFAULT_EXCLUDE
+    if "none" in excluded:
+        # the append action cannot express an empty list; the 'none'
+        # sentinel runs the full unfiltered suite (216 cases)
+        if len(excluded) > 1:
+            raise SystemExit(
+                "--exclude none must be the only --exclude value "
+                "(it disables the default excludes entirely)"
+            )
+        excluded = []
     validate_tags(args.include)
     validate_tags(excluded)
 

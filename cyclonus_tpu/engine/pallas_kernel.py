@@ -209,10 +209,12 @@ def verdict_counts_pallas(
 
     n_i = n_pad // BS
     # per-(q, src-tile) partial counts stay within int32: BS * n_pad
-    # allowed cells max per block
-    assert BS * n_pad < 2**31, (
-        f"pod axis {n_pad} too large for int32 tile counts at BS={BS}"
-    )
+    # allowed cells max per block (raise, not assert — this runtime size
+    # guard must survive python -O)
+    if BS * n_pad >= 2**31:
+        raise ValueError(
+            f"pod axis {n_pad} too large for int32 tile counts at BS={BS}"
+        )
     grid = (q, n_i, n_pad // BD, max(n_k_e, n_k_i))
     clamp_e = lambda k: jnp.minimum(k, n_k_e - 1)
     clamp_i = lambda k: jnp.minimum(k, n_k_i - 1)

@@ -16,6 +16,7 @@ Differences from the reference, on purpose:
 
 from __future__ import annotations
 
+import dataclasses
 import random
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -232,11 +233,15 @@ class MockKubernetes(IKubernetes):
                 f"service {service.namespace}/{service.name} already present"
             )
         if not service.cluster_ip:
-            # a real apiserver allocates a ClusterIP; without one the
-            # probe's service-ip destination mode targets an empty host
+            # a real apiserver allocates a ClusterIP on a COPY — the
+            # caller's object must not mutate (a re-submit of the same
+            # object would otherwise carry the stale IP)
             self._service_id += 1
-            service.cluster_ip = (
-                f"10.96.{self._service_id // 256}.{self._service_id % 256}"
+            service = dataclasses.replace(
+                service,
+                cluster_ip=(
+                    f"10.96.{self._service_id // 256}.{self._service_id % 256}"
+                ),
             )
         ns.services[service.name] = service
         return service

@@ -6,18 +6,18 @@ cd "$(dirname "$0")/.."
 run() { echo "+ $*"; python -m cyclonus_tpu "$@"; echo; }
 
 run analyze --mode parse --mode explain --mode lint \
-  --policy-path examples/networkpolicies/simple-example
+  --policy-path examples/networkpolicies/getting-started
 
 run analyze --mode query-target \
-  --policy-path examples/networkpolicies/simple-example \
+  --policy-path examples/networkpolicies/getting-started \
   --target-pod-path examples/targets.json
 
 run analyze --mode query-traffic \
-  --policy-path examples/networkpolicies/simple-example \
+  --policy-path examples/networkpolicies/getting-started \
   --traffic-path examples/traffic.json
 
 run analyze --mode probe --engine tpu \
-  --policy-path examples/networkpolicies/simple-example \
+  --policy-path examples/networkpolicies/getting-started \
   --probe-path examples/probe.json
 
 run generate --mock --dry-run

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Install Antrea into the kind cluster created with disableDefaultCNI
+# (called by ../run-conformance.sh with the cluster name as $1).
+#
+# Recent Antrea releases ship a single antrea.yml that runs on kind
+# directly (OVS userspace datapath is auto-selected), so no repo clone or
+# image build is needed (the reference's hack/kind/antrea/setup-kind.sh
+# predates that and builds from source).
+set -euo pipefail
+
+CLUSTER_NAME=${1:?cluster name required}
+ANTREA_VERSION=${ANTREA_VERSION:-v1.15.1}
+
+kind export kubeconfig --name "$CLUSTER_NAME"
+kubectl apply -f \
+  "https://github.com/antrea-io/antrea/releases/download/${ANTREA_VERSION}/antrea.yml"
+kubectl -n kube-system rollout status daemonset/antrea-agent --timeout=300s
+kubectl wait --for=condition=Ready nodes --all --timeout=300s

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Install Cilium into the kind cluster created with disableDefaultCNI
+# (called by ../run-conformance.sh with the cluster name as $1).
+#
+# Prefers the cilium CLI (handles kind quirks itself); falls back to the
+# helm chart with the kind-recommended values (reference:
+# hack/kind/cilium/setup-kind.sh — same chart, older pinned version).
+set -euo pipefail
+
+CLUSTER_NAME=${1:?cluster name required}
+CILIUM_VERSION=${CILIUM_VERSION:-1.15.6}
+
+kind export kubeconfig --name "$CLUSTER_NAME"
+
+if command -v cilium >/dev/null; then
+  cilium install --version "${CILIUM_VERSION}" --wait
+else
+  helm repo add cilium https://helm.cilium.io/ >/dev/null
+  helm repo update >/dev/null
+  helm upgrade --install cilium cilium/cilium \
+    --version "${CILIUM_VERSION}" \
+    --namespace kube-system \
+    --set image.pullPolicy=IfNotPresent \
+    --set ipam.mode=kubernetes \
+    --set operator.replicas=1
+fi
+
+kubectl -n kube-system rollout status daemonset/cilium --timeout=300s
+kubectl wait --for=condition=Ready nodes --all --timeout=300s

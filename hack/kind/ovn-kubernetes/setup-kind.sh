@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Create a kind cluster running ovn-kubernetes (called by
+# ../run-conformance.sh with the cluster name as $1).
+#
+# ovn-kubernetes owns its own kind bring-up (contrib/kind.sh builds the
+# images and creates the cluster), so unlike the other CNIs this is a
+# whole-cluster setup hook, not a kind-config + installer pair
+# (reference: hack/kind/ovn-kubernetes/setup-kind.sh does the same via a
+# source clone).
+set -euo pipefail
+
+CLUSTER_NAME=${1:?cluster name required}
+OVN_DIR=${OVN_DIR:-ovn-kubernetes-repo}
+OVN_REF=${OVN_REF:-master}
+
+if [[ ! -d "$OVN_DIR" ]]; then
+  git clone --depth 1 --branch "$OVN_REF" \
+    https://github.com/ovn-org/ovn-kubernetes "$OVN_DIR"
+fi
+
+pushd "$OVN_DIR/contrib" >/dev/null
+KIND_CLUSTER_NAME="$CLUSTER_NAME" ./kind.sh
+popd >/dev/null
+
+kind export kubeconfig --name "$CLUSTER_NAME"
+kubectl wait --for=condition=Ready nodes --all --timeout=300s

@@ -32,14 +32,18 @@ if ! command -v kind >/dev/null; then
   exit 1
 fi
 
-# a named CNI needs BOTH its kind config and an installer; check before
-# any cluster exists so a half-provisioned rerun can't sail past
-if [ "$CNI" != "default" ]; then
+# a named CNI provides EITHER a whole-cluster setup hook (setup-kind.sh —
+# CNIs like ovn-kubernetes that own their kind bring-up) OR a
+# kind-config.yaml + install.sh pair; check before any cluster exists so
+# a half-provisioned rerun can't sail past
+CNI_SETUP="$REPO_ROOT/hack/kind/$CNI/setup-kind.sh"
+if [ "$CNI" != "default" ] && [ ! -x "$CNI_SETUP" ]; then
   if [ ! -f "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml" ] ||
      [ ! -x "$REPO_ROOT/hack/kind/$CNI/install.sh" ]; then
-    echo "hack/kind/$CNI/ must provide kind-config.yaml and an executable" \
-         "install.sh (a disableDefaultCNI cluster without them tests the" \
-         "wrong CNI or stays NotReady)" >&2
+    echo "hack/kind/$CNI/ must provide either an executable setup-kind.sh" \
+         "or kind-config.yaml plus an executable install.sh (a" \
+         "disableDefaultCNI cluster without them tests the wrong CNI or" \
+         "stays NotReady)" >&2
     exit 1
   fi
 fi
@@ -47,6 +51,8 @@ fi
 if ! kind get clusters | grep -qx "$CLUSTER_NAME"; then
   if [ "$CNI" = "default" ]; then
     kind create cluster --name "$CLUSTER_NAME"
+  elif [ -x "$CNI_SETUP" ]; then
+    "$CNI_SETUP" "$CLUSTER_NAME"
   else
     kind create cluster --name "$CLUSTER_NAME" \
       --config "$REPO_ROOT/hack/kind/$CNI/kind-config.yaml"
@@ -56,7 +62,7 @@ fi
 # install (or re-assert) the CNI OUTSIDE the creation branch: installers
 # are idempotent kubectl-applies, so a rerun after a failed install still
 # converges instead of skipping straight to a NotReady cluster
-if [ "$CNI" != "default" ]; then
+if [ "$CNI" != "default" ] && [ ! -x "$CNI_SETUP" ]; then
   "$REPO_ROOT/hack/kind/$CNI/install.sh" "$CLUSTER_NAME"
 fi
 
@@ -81,5 +87,39 @@ kubectl get pods -A
 export CYCLONUS_AGNHOST_IMAGE="$AGNHOST_IMAGE"
 export CYCLONUS_WORKER_IMAGE="$WORKER_IMAGE"
 
-# shellcheck disable=SC2086  # intentional word splitting of ARGS
-(cd "$REPO_ROOT" && python -m cyclonus_tpu $ARGS)
+if [ "${RUN_FROM_SOURCE:-true}" = true ]; then
+  # shellcheck disable=SC2086  # intentional word splitting of ARGS
+  (cd "$REPO_ROOT" && python -m cyclonus_tpu $ARGS)
+else
+  # in-cluster mode (reference run-cyclonus.sh RUN_FROM_SOURCE=false):
+  # build the CLI image, run the generator as a Job with cluster-admin.
+  # NB: the Job's generator args come from the manifest, not $ARGS
+  CLI_IMAGE=${CLI_IMAGE:-cyclonus-tpu:latest}
+  if [ "$ARGS" != "generate --include conflict" ]; then
+    echo "note: in-cluster mode takes its generator args from" \
+         "hack/kind/cyclonus-job.yaml; ARGS is ignored" >&2
+  fi
+  docker build -t "$CLI_IMAGE" "$REPO_ROOT"
+  kind load docker-image "$CLI_IMAGE" --name "$CLUSTER_NAME"
+  # rewrite the image so a CLI_IMAGE override reaches the Job, and point
+  # the in-cluster generator at exactly the probe images preloaded above
+  sed -e "s|image: cyclonus-tpu:latest|image: ${CLI_IMAGE}|" \
+      -e "s|value: registry.k8s.io/e2e-test-images/agnhost:2.28|value: ${AGNHOST_IMAGE}|" \
+      -e "s|value: cyclonus-tpu-worker:latest|value: ${WORKER_IMAGE}|" \
+      "$REPO_ROOT/hack/kind/cyclonus-job.yaml" | kubectl apply -f -
+  # the Job controller creates the pod asynchronously: poll until it
+  # exists (a completed pod is Ready=False, so waiting on Ready races)
+  for _ in $(seq 1 60); do
+    kubectl get pods -n netpol -l job-name=cyclonus -o name 2>/dev/null \
+      | grep -q . && break
+    sleep 5
+  done
+  kubectl logs -f -n netpol job/cyclonus || true
+  # propagate the Job's verdict: logs -f returns 0 even for a failed run
+  if ! kubectl wait --for=condition=complete job/cyclonus -n netpol \
+      --timeout=2m; then
+    echo "conformance job did not complete successfully" >&2
+    kubectl describe job/cyclonus -n netpol >&2 || true
+    exit 1
+  fi
+fi

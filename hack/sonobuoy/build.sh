@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Build the sonobuoy plugin image: the base CLI image from the repo root,
+# then the plugin layer (reference: hack/sonobuoy/build.sh; push is left
+# to the caller — set PUSH=true with a registry-qualified IMAGE).
+set -euo pipefail
+
+IMAGE=${IMAGE:-cyclonus-tpu-sonobuoy:latest}
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+
+docker build -t cyclonus-tpu:latest "$REPO_ROOT"
+docker build -t "$IMAGE" "$(dirname "$0")"
+
+if [ "${PUSH:-false}" = true ]; then
+  docker push "$IMAGE"
+fi

@@ -1,0 +1,15 @@
+#!/usr/bin/env sh
+# Sonobuoy plugin entrypoint (reference: hack/sonobuoy/run-sonobuoy-plugin.sh).
+# Runs the conformance generator with the args sonobuoy passes through,
+# then packages the output the way the sonobuoy worker expects: a tarball
+# plus a `done` file containing its path.
+set -eu
+
+RESULTS_DIR="${RESULTS_DIR:-/tmp/results}"
+mkdir -p "${RESULTS_DIR}"
+
+cyclonus-tpu "$@" > "${RESULTS_DIR}/results.txt" 2>&1 || true
+
+cd "${RESULTS_DIR}"
+tar czf results.tar.gz results.txt
+realpath results.tar.gz > ./done

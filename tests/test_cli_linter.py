@@ -202,7 +202,7 @@ class TestCLI:
         proc = run_cli(
             "analyze",
             "--policy-path",
-            "examples/networkpolicies/simple-example",
+            "examples/networkpolicies/getting-started",
             "--mode",
             "probe",
             "--probe-path",
@@ -243,7 +243,7 @@ class TestCLI:
             "--probe-protocol",
             "tcp",
             "--policy-path",
-            "examples/networkpolicies/simple-example",
+            "examples/networkpolicies/getting-started",
             timeout=600,
         )
         assert proc.returncode == 0, proc.stderr

@@ -1,0 +1,59 @@
+"""Opt-in full-conformance pin: ALL default generator cases (216 — the
+reference's golden total, testcasegenerator_tests.go:11-24) through the
+Interpreter against the perfect-CNI mock, with a crash-safe journal.
+
+Run with `pytest -m conformance` (excluded from the default run by
+pyproject's addopts).  The identical run is reproducible as one CLI
+command:
+
+    python -m cyclonus_tpu generate --mock --perfect-cni --exclude none \
+        --journal artifacts/conformance-journal.jsonl
+
+and the committed artifact at artifacts/conformance-journal.jsonl is the
+journal of exactly such a run (216 entries, all passed).  Set
+CYCLONUS_CONFORMANCE_JOURNAL to refresh it via this test.
+"""
+
+import json
+import os
+
+import pytest
+
+from cyclonus_tpu.cli.root import main
+
+EXPECTED_CASES = 216
+
+
+@pytest.mark.conformance
+def test_full_conformance_216(tmp_path, capsys):
+    journal = os.environ.get("CYCLONUS_CONFORMANCE_JOURNAL") or str(
+        tmp_path / "conformance-journal.jsonl"
+    )
+    rc = main(
+        [
+            "generate",
+            "--mock",
+            "--perfect-cni",
+            "--exclude",
+            "none",
+            "--journal",
+            journal,
+        ]
+    )
+    assert rc == 0
+
+    with open(journal, "r", encoding="utf-8") as f:
+        entries = [json.loads(line) for line in f if line.strip()]
+    assert len(entries) == EXPECTED_CASES, (
+        f"expected {EXPECTED_CASES} journaled cases, got {len(entries)}"
+    )
+    failed = [e for e in entries if not e["passed"] or e["error"]]
+    assert not failed, (
+        f"{len(failed)} case(s) failed: "
+        f"{[e['description'] for e in failed][:5]}"
+    )
+
+    out = capsys.readouterr().out
+    assert f"total: {EXPECTED_CASES} test cases" in out
+    # the printed summary must show no failures either
+    assert "failed" not in out.split("Summary:")[1]

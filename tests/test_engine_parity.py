@@ -115,18 +115,30 @@ CASES_MULTI = [
 
 
 REFERENCE = "/root/reference/networkpolicies/simple-example"
-BUNDLED = str(Path(__file__).resolve().parents[1] / "examples/networkpolicies/simple-example")
+FIXTURES = Path(__file__).resolve().parents[1] / "examples/networkpolicies"
+BUNDLED = str(FIXTURES / "simple-example")
 requires_reference = pytest.mark.skipif(
     not os.path.isdir(REFERENCE), reason="reference checkout not present"
 )
 
 
 class TestSimpleExampleParity:
+    """The bundled 7-policy simple-example (equivalent of the reference's
+    networkpolicies/simple-example) — the repo is self-contained; the
+    reference-checkout tests below are optional cross-checks."""
+
     def test_bundled_fixture(self):
         pols = load_policies_from_path(BUNDLED)
+        assert len(pols) == 7
         policy = build_network_policies(True, pols)
         pods, namespaces = default_cluster()
         assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_bundled_fixture_sharded(self):
+        pols = load_policies_from_path(BUNDLED)
+        policy = build_network_policies(True, pols)
+        pods, namespaces = default_cluster()
+        assert_parity(policy, pods, namespaces, CASES_MULTI, sharded=True)
 
     @requires_reference
     def test_reference_fixture(self):
@@ -138,13 +150,64 @@ class TestSimpleExampleParity:
         assert_parity(policy, pods, namespaces, CASES_MULTI)
 
     @requires_reference
-    def test_reference_fixture_sharded(self):
-        pols = load_policies_from_path(
-            REFERENCE
-        )
+    def test_bundled_matches_reference(self):
+        """The bundled fixture must stay semantically identical to the
+        reference's: same truth table over the default cluster."""
+        from cyclonus_tpu.engine import TpuPolicyEngine
+
+        pods, namespaces = default_cluster()
+        grids = []
+        for path in (BUNDLED, REFERENCE):
+            policy = build_network_policies(True, load_policies_from_path(path))
+            engine = TpuPolicyEngine(policy, pods, namespaces)
+            grids.append(engine.evaluate_grid(CASES_MULTI))
+        import numpy as np
+
+        assert np.array_equal(grids[0].combined, grids[1].combined)
+        assert np.array_equal(grids[0].ingress, grids[1].ingress)
+        assert np.array_equal(grids[0].egress, grids[1].egress)
+
+
+class TestBundledFeatureFixtures:
+    """Parity over the other bundled fixture files (equivalents of the
+    reference's networkpolicies/{allow-all,allow-all-internal}.yaml,
+    features/portrange1.yaml, upstream_test_cases/)."""
+
+    def test_portrange(self):
+        pols = load_policies_from_path(str(FIXTURES / "features"))
         policy = build_network_policies(True, pols)
         pods, namespaces = default_cluster()
-        assert_parity(policy, pods, namespaces, CASES_MULTI, sharded=True)
+        cases = [
+            PortCase(79, "", "TCP"),
+            PortCase(80, "", "TCP"),
+            PortCase(103, "", "TCP"),
+            PortCase(104, "", "TCP"),
+            PortCase(53, "", "UDP"),
+        ]
+        assert_parity(policy, pods, namespaces, cases)
+
+    def test_upstream_case(self):
+        pols = load_policies_from_path(str(FIXTURES / "upstream_test_cases"))
+        policy = build_network_policies(True, pols)
+        pods, namespaces = default_cluster()
+        assert_parity(policy, pods, namespaces, CASES_MULTI)
+
+    def test_allow_all_vs_allow_all_internal(self):
+        """allow-all (empty from) admits external IPs; allow-all-internal
+        (empty namespaceSelector) admits only cluster pods — the grid
+        engine must reproduce the oracle on both."""
+        namespaces = {"abcd": {"ns": "abcd"}, "x": {"ns": "x"}}
+        pods = [
+            ("abcd", "a", {"pod": "a"}, "192.168.1.1"),
+            ("abcd", "b", {"pod": "b"}, "192.168.1.2"),
+            ("x", "a", {"pod": "a"}, "192.168.1.3"),
+        ]
+        for fname in ("allow-all.yaml", "allow-all-internal.yaml"):
+            from cyclonus_tpu.kube.yaml_io import load_policies_from_file
+
+            pols = load_policies_from_file(str(FIXTURES / fname))
+            policy = build_network_policies(True, pols)
+            assert_parity(policy, pods, namespaces, CASES_TCP80)
 
 
 def mkpol(name, ns, pod_sel, types, ingress=None, egress=None):

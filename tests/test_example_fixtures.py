@@ -1,0 +1,105 @@
+"""The example-policy library and pathological fixtures: every canned
+policy must compile, lint, explain, and (for the pathological set) hold
+oracle-vs-engine parity (reference: pkg/kube/netpol/{policies,kubedocs,
+pathological,basic,complicated}.go; AllExamples at policies.go:699-728)."""
+
+from cyclonus_tpu.engine import PortCase
+from cyclonus_tpu.kube import pathological as pa
+from cyclonus_tpu.kube.examples import all_examples
+from cyclonus_tpu.matcher import build_network_policies, explain_table
+
+from tests.test_engine_parity import assert_parity
+
+
+class TestAllExamples:
+    def test_count_matches_reference(self):
+        # policies.go:699-728 AllExamples has exactly 21 entries
+        assert len(all_examples()) == 21
+
+    def test_each_example_compiles_and_explains(self):
+        for pol in all_examples():
+            compiled = build_network_policies(True, [pol])
+            text = explain_table(compiled)
+            assert pol.namespace in text
+
+    def test_all_together(self):
+        compiled = build_network_policies(True, all_examples())
+        assert explain_table(compiled)
+
+
+class TestPathologicalFixtures:
+    def _cluster(self):
+        ns = pa.NAMESPACE
+        namespaces = {ns: {"ns": ns}, "other": pa.LABELS_AB}
+        pods = [
+            (ns, "a", dict(pa.LABELS_AB), "10.0.0.1"),
+            (ns, "b", dict(pa.LABELS_CD), "10.0.0.2"),
+            ("other", "c", dict(pa.LABELS_EF), "10.0.0.3"),
+            ("other", "d", dict(pa.LABELS_GH), "192.168.242.1"),
+        ]
+        return pods, namespaces
+
+    def test_policies_compile(self):
+        assert len(pa.ALL_PATHOLOGICAL_POLICIES) == 9
+        compiled = build_network_policies(True, pa.ALL_PATHOLOGICAL_POLICIES)
+        assert explain_table(compiled)
+
+    def test_deny_and_allow_pairs_parity(self):
+        """Each pathological policy alone: engine == oracle on a cluster
+        crossing the shared-selector labels and the ipblock ranges."""
+        pods, namespaces = self._cluster()
+        cases = [PortCase(80, "", "TCP"), PortCase(9001, "", "TCP")]
+        for pol in pa.ALL_PATHOLOGICAL_POLICIES:
+            policy = build_network_policies(True, [pol])
+            assert_parity(policy, pods, namespaces, cases)
+
+    def test_peer_fixture_policies_parity(self):
+        """Every peer-combination fixture wrapped in an ingress rule:
+        engine == oracle (the 6 all-pods shapes + 3 matching shapes + the
+        except-carrying ipblock)."""
+        from cyclonus_tpu.kube.netpol import (
+            NetworkPolicy,
+            NetworkPolicyIngressRule,
+            NetworkPolicySpec,
+        )
+
+        peers = [
+            pa.ALLOW_ALL_PODS_IN_POLICY_NAMESPACE_PEER,
+            pa.ALLOW_ALL_PODS_IN_ALL_NAMESPACES_PEER,
+            pa.ALLOW_ALL_PODS_IN_MATCHING_NAMESPACES_PEER,
+            pa.ALLOW_ALL_PODS_IN_POLICY_NAMESPACE_PEER_EMPTY_POD_SELECTOR,
+            pa.ALLOW_ALL_PODS_IN_ALL_NAMESPACES_PEER_EMPTY_POD_SELECTOR,
+            pa.ALLOW_ALL_PODS_IN_MATCHING_NAMESPACES_PEER_EMPTY_POD_SELECTOR,
+            pa.ALLOW_MATCHING_PODS_IN_POLICY_NAMESPACE_PEER,
+            pa.ALLOW_MATCHING_PODS_IN_ALL_NAMESPACES_PEER,
+            pa.ALLOW_MATCHING_PODS_IN_MATCHING_NAMESPACES_PEER,
+            pa.ALLOW_IPBLOCK_PEER,
+        ]
+        pods, namespaces = self._cluster()
+        cases = [PortCase(80, "", "TCP")]
+        for i, peer in enumerate(peers):
+            pol = NetworkPolicy(
+                name=f"peer-fixture-{i}",
+                namespace=pa.NAMESPACE,
+                spec=NetworkPolicySpec(
+                    pod_selector=pa.SELECTOR_EMPTY,
+                    policy_types=["Ingress"],
+                    ingress=[NetworkPolicyIngressRule(from_=[peer])],
+                ),
+            )
+            policy = build_network_policies(True, [pol])
+            assert_parity(policy, pods, namespaces, cases)
+
+    def test_basic_and_complicated_compile_and_parity(self):
+        pods, namespaces = self._cluster()
+        cases = [PortCase(3333, "", "TCP"), PortCase(80, "", "TCP")]
+        pols = [
+            pa.allow_nothing_from(pa.NAMESPACE, pa.SELECTOR_AB),
+            pa.allow_from_to_ns_labels(pa.NAMESPACE, pa.SELECTOR_AB, {"ns": "other"}),
+            pa.allow_all_ingress_policy(pa.NAMESPACE),
+            pa.allow_all_egress_policy(pa.NAMESPACE),
+            pa.example_complicated_network_policy(),
+        ]
+        for pol in pols:
+            policy = build_network_policies(True, [pol])
+            assert_parity(policy, pods, namespaces, cases)
