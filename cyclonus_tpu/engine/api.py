@@ -117,9 +117,10 @@ class GridVerdict:
 
 
 def _direction_tensors(enc: _DirectionEncoding) -> Dict:
-    m_tp = np.zeros((enc.n_targets, enc.n_peers), dtype=bool)
-    for p, t in enumerate(enc.peer_target):
-        m_tp[t, p] = True
+    # peer->target mapping ships as a [P] index vector; kernels build the
+    # dense one-hot on device (kernel.m_tp_onehot) — the materialized
+    # [T, P] matrix is ~70 MB at bench scale, dominating device_put time
+    peer_target = np.asarray(enc.peer_target, dtype=np.int32).reshape(-1)
     d = {
         "target_ns": enc.target_ns,
         "target_sel": enc.target_sel,
@@ -135,10 +136,71 @@ def _direction_tensors(enc: _DirectionEncoding) -> Dict:
         "ex_base": enc.ex_base,
         "ex_mask": enc.ex_mask,
         "ex_valid": enc.ex_valid,
-        "m_tp": m_tp,
+        "peer_target": peer_target,
         "port_spec": dict(enc.port_spec),
     }
     return d
+
+
+def _pack_tensors(tree):
+    """Pack a numpy pytree into one int32 buffer + an unpack function.
+
+    A remote-attached (tunneled) TPU pays ~50-100 ms of round-trip
+    overhead PER BUFFER, so device_put of the ~57-leaf tensor dict costs
+    seconds even though it is only a few MB.  Packing every leaf into a
+    single int32 buffer makes it one transfer; `unpack` rebuilds the
+    pytree from the buffer with static slices + bitcasts and is designed
+    to be traced INSIDE a consumer jit (so the unpack adds no extra
+    dispatch or executable of its own).
+
+    Returns (packed_int32_np, unpack) where unpack(buf_jnp) -> pytree."""
+    from jax import tree_util as jtu
+
+    leaves, treedef = jtu.tree_flatten(tree)
+    metas = []  # (dtype, shape, word_offset, n_words)
+    chunks = []
+    off = 0
+    for leaf in leaves:
+        a = np.ascontiguousarray(leaf)
+        if a.dtype not in (np.dtype(np.int32), np.dtype(np.uint32), np.dtype(bool)):
+            # unpack below BITCASTS from int32 words; any other dtype
+            # would be silently reinterpreted — fail loudly instead
+            raise TypeError(f"_pack_tensors: unsupported leaf dtype {a.dtype}")
+        raw = a.tobytes()
+        pad = (-len(raw)) % 4
+        if pad:
+            raw += b"\0" * pad
+        words = np.frombuffer(raw, dtype=np.int32)
+        metas.append((a.dtype, a.shape, off, words.size))
+        chunks.append(words)
+        off += words.size
+    packed = np.concatenate(chunks) if chunks else np.zeros(0, np.int32)
+
+    def unpack(buf):
+        import jax
+        import jax.numpy as jnp
+        from jax import tree_util as jtu2
+
+        outs = []
+        for dtype, shape, o, nw in metas:
+            n = int(np.prod(shape))
+            if n == 0:
+                outs.append(jnp.zeros(shape, dtype=dtype))
+                continue
+            words = buf[o : o + nw]
+            if dtype == np.bool_:
+                flat = jax.lax.bitcast_convert_type(words, jnp.uint8)
+                arr = flat.reshape(-1)[:n].astype(jnp.bool_)
+            elif dtype == np.uint32:
+                arr = jax.lax.bitcast_convert_type(words, jnp.uint32)
+            else:  # int32 (the only other dtype _pack_tensors accepts)
+                arr = words
+            outs.append(arr.reshape(shape))
+        return jtu2.tree_unflatten(treedef, outs)
+
+    return packed, unpack
+
+
 
 
 class TpuPolicyEngine:
@@ -160,6 +222,9 @@ class TpuPolicyEngine:
             self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
             self._tensors = self._build_tensors()
         self._device_tensors = None  # lazily device_put once
+        self._packed_buf = None  # single-buffer device copy (counts path)
+        self._unpack = None
+        self._counts_packed_jit = None
         self._has_ip_peers = (
             bool(np.any(self.encoding.ingress.peer_kind == PEER_IP))
             or bool(np.any(self.encoding.egress.peer_kind == PEER_IP))
@@ -259,18 +324,32 @@ class TpuPolicyEngine:
             out["combined"],
         )
 
+    def _ensure_packed(self):
+        """Single-buffer device copy of the tensor dict (one transfer —
+        per-buffer tunnel round trips dominate a multi-leaf device_put).
+        Shared by the packed counts path and the unpacked device-tensor
+        cache so the transfer happens at most once per engine."""
+        if self._packed_buf is None:
+            import jax
+
+            with phase("engine.device_put"):
+                packed, unpack = _pack_tensors(self._tensors)
+                self._packed_buf = jax.device_put(packed)
+                self._unpack = unpack
+        return self._packed_buf
+
     def _tensors_with_cases(
         self, cases: Sequence[PortCase], device: bool = False
     ) -> Dict:
-        """Tensors + port-case arrays.  device=True reuses the device_put
-        cache (paths that don't re-pad the pod axis host-side)."""
+        """Tensors + port-case arrays.  device=True reuses the packed
+        device buffer (paths that don't re-pad the pod axis host-side)."""
         q_port, q_name, q_proto = self._port_case_arrays(cases)
         if device:
             import jax
 
             if self._device_tensors is None:
-                with phase("engine.device_put"):
-                    self._device_tensors = jax.device_put(self._tensors)
+                buf = self._ensure_packed()
+                self._device_tensors = jax.jit(self._unpack)(buf)
             tensors = dict(self._device_tensors)
         else:
             tensors = dict(self._tensors)
@@ -302,18 +381,58 @@ class TpuPolicyEngine:
         if not cases or n == 0:
             return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
         if backend == "pallas":
-            from .pallas_kernel import evaluate_grid_counts_pallas
-
-            # no host-side padding here, so the device_put cache applies
-            return evaluate_grid_counts_pallas(
-                self._tensors_with_cases(cases, device=True), n
-            )
+            return self._counts_pallas_packed(cases, n)
         from .tiled import evaluate_grid_counts
 
         # the xla path pads the pod axis with numpy before dispatch
         return evaluate_grid_counts(
             self._tensors_with_cases(cases), n, block=block
         )
+
+    def _counts_pallas_packed(self, cases: Sequence[PortCase], n: int) -> Dict[str, int]:
+        """The fused pallas counts path over the SINGLE-BUFFER tensor
+        transfer: unpack + precompute + pallas counts all trace into one
+        jit, so a cold process pays one host->device transfer, one trace,
+        one (persistently cached) compile, and one execution — per-buffer
+        tunnel round trips and separate precompute dispatch disappear
+        from warmup."""
+        import jax
+
+        buf = self._ensure_packed()
+        if self._counts_packed_jit is None:
+            from .pallas_kernel import _should_interpret, verdict_counts_pallas
+            from .tiled import _precompute
+
+            unpack = self._unpack
+            interpret = _should_interpret()
+
+            @jax.jit
+            def counts_packed(buf, q_port, q_name, q_proto, n_pods):
+                tensors = dict(unpack(buf))
+                tensors["q_port"] = q_port
+                tensors["q_name"] = q_name
+                tensors["q_proto"] = q_proto
+                pre = _precompute(tensors)
+                return verdict_counts_pallas(
+                    pre["egress"]["tmatch"],
+                    pre["egress"]["has_target"],
+                    pre["egress"]["tallow_bf"],
+                    pre["ingress"]["tmatch"],
+                    pre["ingress"]["has_target"],
+                    pre["ingress"]["tallow_bf"],
+                    n_pods=n_pods,
+                    interpret=interpret,
+                )
+
+            self._counts_packed_jit = counts_packed
+        from .pallas_kernel import sum_partials
+
+        q_port, q_name, q_proto = self._port_case_arrays(cases)
+        with phase("engine.dispatch"):
+            partials = self._counts_packed_jit(
+                buf, q_port, q_name, q_proto, np.int32(n)
+            )
+        return sum_partials(partials, len(cases), n)
 
     def evaluate_grid_counts_sharded(
         self, cases: Sequence[PortCase], block: int = 1024, mesh=None

@@ -256,10 +256,26 @@ def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def sum_partials(partials, q: int, n_pods: int) -> Dict[str, int]:
+    """Host-side int64 reduction of [Q, n_tiles, 3] partials into the
+    counts dict — the ONE place that knows the lane order (ingress,
+    egress, combined).  jnp int64 silently truncates without
+    jax_enable_x64, hence numpy."""
+    import numpy as np
+
+    c = np.asarray(partials, dtype=np.int64).sum(axis=(0, 1))
+    return {
+        "ingress": int(c[0]),
+        "egress": int(c[1]),
+        "combined": int(c[2]),
+        "cells": q * n_pods * n_pods,
+    }
+
+
 def evaluate_grid_counts_pallas(tensors: Dict, n_pods: int) -> Dict[str, int]:
     """Drop-in alternative to tiled.evaluate_grid_counts riding the fused
     Pallas kernel.  Per-(port case, src-tile) partials are int32-bounded
-    (BS * N < 2^31, asserted); totals are summed host-side in int64."""
+    (BS * N < 2^31, checked); totals are summed host-side in int64."""
     from .tiled import _precompute_jit
 
     pre = _precompute_jit(tensors)
@@ -273,13 +289,4 @@ def evaluate_grid_counts_pallas(tensors: Dict, n_pods: int) -> Dict[str, int]:
         n_pods=n_pods,
         interpret=_should_interpret(),
     )
-    import numpy as np
-
-    c = np.asarray(partials, dtype=np.int64).sum(axis=(0, 1))
-    q = int(tensors["q_port"].shape[0])
-    return {
-        "ingress": int(c[0]),
-        "egress": int(c[1]),
-        "combined": int(c[2]),
-        "cells": q * n_pods * n_pods,
-    }
+    return sum_partials(partials, int(tensors["q_port"].shape[0]), n_pods)

@@ -46,7 +46,13 @@ def shard_map_no_check(fn, mesh, in_specs, out_specs):
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **check_kw
     )
 
-from .kernel import _bool_matmul, direction_precompute, port_spec_allows, selector_match
+from .kernel import (
+    _bool_matmul,
+    direction_precompute,
+    m_tp_onehot,
+    port_spec_allows,
+    selector_match,
+)
 
 # pod-axis-sharded tensor keys
 _POD_KEYS = ("pod_ns_id", "pod_kv", "pod_key", "pod_ip", "pod_ip_valid")
@@ -202,7 +208,7 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
     peer_allow_e = (
         pre_e["peer_match"][:, :, None] & pport["egress"][:, None, :]
     ).reshape(pre_e["peer_match"].shape[0], n_b * q)
-    tallow_e_local = _bool_matmul(enc_e["m_tp"], peer_allow_e)  # [T, Nb*Q]
+    tallow_e_local = _bool_matmul(m_tp_onehot(enc_e), peer_allow_e)  # [T, Nb*Q]
     t_e = tallow_e_local.shape[0]
     # one collective per eval: gather destination-side target_allows
     g_tallow_e = jax.lax.all_gather(
@@ -219,7 +225,7 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
     peer_allow_i = (
         pre_i["peer_match"][:, :, None] & pport["ingress"][:, None, :]
     ).reshape(pre_i["peer_match"].shape[0], n_b * q)
-    tallow_i_local = _bool_matmul(enc_i["m_tp"], peer_allow_i)  # [T, Nb*Q]
+    tallow_i_local = _bool_matmul(m_tp_onehot(enc_i), peer_allow_i)  # [T, Nb*Q]
     t_i = tallow_i_local.shape[0]
     # port-independent collectives: gather target-side matches
     g_tmatch_i = jax.lax.all_gather(pre_i["tmatch"], "x", axis=1, tiled=True)  # [T, N]

@@ -40,7 +40,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .kernel import direction_precompute, port_spec_allows, selector_match
+from .kernel import (
+    direction_precompute,
+    m_tp_onehot,
+    port_spec_allows,
+    selector_match,
+)
 
 
 def _apply_host_ip(enc: Dict, pre: Dict) -> Dict:
@@ -100,7 +105,7 @@ def _precompute(tensors: Dict) -> Dict[str, Dict[str, jnp.ndarray]]:
             pre["peer_match"][:, :, None] & pport[:, None, :]
         ).reshape(n_p, n * q)
         tallow = jnp.matmul(
-            enc["m_tp"].astype(jnp.bfloat16),
+            m_tp_onehot(enc).astype(jnp.bfloat16),
             peer_allow.astype(jnp.bfloat16),
             preferred_element_type=jnp.bfloat16,
         )
@@ -537,7 +542,7 @@ def evaluate_pairs_kernel(
         tallow = (
             jnp.einsum(
                 "tp,pkq->tkq",
-                enc["m_tp"].astype(jnp.bfloat16),
+                m_tp_onehot(enc).astype(jnp.bfloat16),
                 peer_allow.astype(jnp.bfloat16),
             )
             > 0

@@ -12,6 +12,7 @@ set -euo pipefail
 
 CNI=${CNI:-default}
 CLUSTER_NAME=${CLUSTER_NAME:-"netpol-$CNI"}
+ARGS_WAS_SET=${ARGS+yes}
 ARGS=${ARGS:-"generate --include conflict"}
 REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
 
@@ -95,7 +96,7 @@ else
   # build the CLI image, run the generator as a Job with cluster-admin.
   # NB: the Job's generator args come from the manifest, not $ARGS
   CLI_IMAGE=${CLI_IMAGE:-cyclonus-tpu:latest}
-  if [ "$ARGS" != "generate --include conflict" ]; then
+  if [ -n "$ARGS_WAS_SET" ]; then
     echo "note: in-cluster mode takes its generator args from" \
          "hack/kind/cyclonus-job.yaml; ARGS is ignored" >&2
   fi
@@ -114,11 +115,26 @@ else
       | grep -q . && break
     sleep 5
   done
+  # stream logs while the run executes (fails harmlessly if the container
+  # is still creating — the verdict poll below is the source of truth)
   kubectl logs -f -n netpol job/cyclonus || true
-  # propagate the Job's verdict: logs -f returns 0 even for a failed run
-  if ! kubectl wait --for=condition=complete job/cyclonus -n netpol \
-      --timeout=2m; then
-    echo "conformance job did not complete successfully" >&2
+  # poll the Job's verdict with a deadline sized for a real conformance
+  # run (logs -f returns 0 even for a failed run, and can also return
+  # early, so a short `kubectl wait` here would misreport healthy runs)
+  verdict=""
+  for _ in $(seq 1 "${JOB_POLLS:-360}"); do
+    complete=$(kubectl get job cyclonus -n netpol \
+      -o jsonpath='{.status.conditions[?(@.type=="Complete")].status}' \
+      2>/dev/null || true)
+    failed=$(kubectl get job cyclonus -n netpol \
+      -o jsonpath='{.status.conditions[?(@.type=="Failed")].status}' \
+      2>/dev/null || true)
+    if [ "$complete" = "True" ]; then verdict=ok; break; fi
+    if [ "$failed" = "True" ]; then verdict=failed; break; fi
+    sleep 10
+  done
+  if [ "$verdict" != ok ]; then
+    echo "conformance job did not complete successfully ($verdict)" >&2
     kubectl describe job/cyclonus -n netpol >&2 || true
     exit 1
   fi
