@@ -222,8 +222,10 @@ class TpuPolicyEngine:
             self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
             self._tensors = self._build_tensors()
         self._device_tensors = None  # lazily device_put once
-        self._packed_buf = None  # single-buffer device copy (counts path)
+        self._packed_buf = None  # single-buffer device copy (grid paths)
         self._unpack = None
+        self._packed_sorted_buf = None  # ns-sorted variant (counts path)
+        self._unpack_sorted = None
         self._counts_packed_jit = None
         self._has_ip_peers = (
             bool(np.any(self.encoding.ingress.peer_kind == PEER_IP))
@@ -324,19 +326,22 @@ class TpuPolicyEngine:
             out["combined"],
         )
 
-    def _ensure_packed(self):
-        """Single-buffer device copy of the tensor dict (one transfer —
-        per-buffer tunnel round trips dominate a multi-leaf device_put).
-        Shared by the packed counts path and the unpacked device-tensor
-        cache so the transfer happens at most once per engine."""
-        if self._packed_buf is None:
+    def _packed_transfer(self, buf_attr: str, unpack_attr: str, tensors: Dict):
+        """Single-buffer device copy with per-engine caching (one
+        transfer — per-buffer tunnel round trips dominate a multi-leaf
+        device_put)."""
+        if getattr(self, buf_attr) is None:
             import jax
 
             with phase("engine.device_put"):
-                packed, unpack = _pack_tensors(self._tensors)
-                self._packed_buf = jax.device_put(packed)
-                self._unpack = unpack
-        return self._packed_buf
+                packed, unpack = _pack_tensors(tensors)
+                setattr(self, buf_attr, jax.device_put(packed))
+                setattr(self, unpack_attr, unpack)
+        return getattr(self, buf_attr)
+
+    def _ensure_packed(self):
+        """Packed device buffer of the caller-order tensors (grid paths)."""
+        return self._packed_transfer("_packed_buf", "_unpack", self._tensors)
 
     def _tensors_with_cases(
         self, cases: Sequence[PortCase], device: bool = False
@@ -389,21 +394,66 @@ class TpuPolicyEngine:
             self._tensors_with_cases(cases), n, block=block
         )
 
+    def _counts_tensors_sorted(self) -> Dict:
+        """Tensor dict with pods AND per-direction targets permuted into
+        namespace order — counts are invariant under both permutations.
+
+        Why: a target applies to pods of exactly one namespace, so with
+        both axes ns-sorted the tmatch matrices become (ragged) block
+        diagonal and most (pod-tile, target-chunk) blocks are ALL ZERO.
+        The pallas counts kernel detects those blocks on device and skips
+        their matmuls (scalar-prefetch nz maps) — the dominant flops term
+        drops from O(N^2 T) dense to the occupied blocks only.  Only the
+        counts path uses the sorted order; grid paths keep caller order."""
+        from .sharded import _POD_KEYS
+
+        t = dict(self._tensors)
+        perm = np.argsort(t["pod_ns_id"], kind="stable")
+        for k in _POD_KEYS:
+            t[k] = np.ascontiguousarray(t[k][perm])
+        for direction in ("ingress", "egress"):
+            d = dict(t[direction])
+            tperm = np.argsort(d["target_ns"], kind="stable")
+            inv = np.empty_like(tperm)
+            inv[tperm] = np.arange(tperm.size)
+            d["target_ns"] = np.ascontiguousarray(d["target_ns"][tperm])
+            d["target_sel"] = np.ascontiguousarray(d["target_sel"][tperm])
+            # peer_target holds TARGET indices: remap through the inverse
+            if d["peer_target"].size:
+                d["peer_target"] = np.ascontiguousarray(
+                    inv[d["peer_target"]].astype(np.int32)
+                )
+            if "host_ip_match" in d:
+                d["host_ip_match"] = np.ascontiguousarray(
+                    d["host_ip_match"][:, perm]
+                )
+            t[direction] = d
+        return t
+
+    def _ensure_packed_sorted(self):
+        """Packed device buffer of the ns-sorted tensors (counts path)."""
+        if self._packed_sorted_buf is None:
+            return self._packed_transfer(
+                "_packed_sorted_buf", "_unpack_sorted", self._counts_tensors_sorted()
+            )
+        return self._packed_sorted_buf
+
     def _counts_pallas_packed(self, cases: Sequence[PortCase], n: int) -> Dict[str, int]:
         """The fused pallas counts path over the SINGLE-BUFFER tensor
         transfer: unpack + precompute + pallas counts all trace into one
         jit, so a cold process pays one host->device transfer, one trace,
         one (persistently cached) compile, and one execution — per-buffer
         tunnel round trips and separate precompute dispatch disappear
-        from warmup."""
+        from warmup.  Tensors are ns-sorted (see _counts_tensors_sorted)
+        so the kernel can skip empty target blocks."""
         import jax
 
-        buf = self._ensure_packed()
+        buf = self._ensure_packed_sorted()
         if self._counts_packed_jit is None:
             from .pallas_kernel import _should_interpret, verdict_counts_pallas
             from .tiled import _precompute
 
-            unpack = self._unpack
+            unpack = self._unpack_sorted
             interpret = _should_interpret()
 
             @jax.jit

@@ -121,8 +121,12 @@ else
   # poll the Job's verdict with a deadline sized for a real conformance
   # run (logs -f returns 0 even for a failed run, and can also return
   # early, so a short `kubectl wait` here would misreport healthy runs)
-  verdict=""
+  verdict=timeout
   for _ in $(seq 1 "${JOB_POLLS:-360}"); do
+    if ! status=$(kubectl get job cyclonus -n netpol -o json 2>&1); then
+      verdict="kubectl-error: $status"
+      break
+    fi
     complete=$(kubectl get job cyclonus -n netpol \
       -o jsonpath='{.status.conditions[?(@.type=="Complete")].status}' \
       2>/dev/null || true)
@@ -130,11 +134,12 @@ else
       -o jsonpath='{.status.conditions[?(@.type=="Failed")].status}' \
       2>/dev/null || true)
     if [ "$complete" = "True" ]; then verdict=ok; break; fi
-    if [ "$failed" = "True" ]; then verdict=failed; break; fi
+    if [ "$failed" = "True" ]; then verdict=job-failed; break; fi
     sleep 10
   done
   if [ "$verdict" != ok ]; then
-    echo "conformance job did not complete successfully ($verdict)" >&2
+    echo "conformance job did not complete successfully: $verdict" \
+         "(polled ${JOB_POLLS:-360}x10s)" >&2
     kubectl describe job/cyclonus -n netpol >&2 || true
     exit 1
   fi
