@@ -142,6 +142,132 @@ def _direction_tensors(enc: _DirectionEncoding) -> Dict:
     return d
 
 
+def _selector_pod_matches_host(tensors: Dict, chunk: int = 65536) -> np.ndarray:
+    """[S, N] bool selector-vs-pod matches, evaluated on the CPU backend
+    in pod chunks (the [S, chunk, ...] broadcast intermediates stay
+    bounded).  Exact kernel semantics — this IS kernel.selector_match,
+    just run host-side so the result is available at encode time."""
+    import jax
+
+    from .kernel import selector_match
+
+    cpu = jax.devices("cpu")[0]
+    n = tensors["pod_kv"].shape[0]
+    s = tensors["sel_req_kv"].shape[0]
+    outs = []
+    with jax.default_device(cpu):
+        for lo in range(0, n, chunk):
+            outs.append(
+                np.asarray(
+                    selector_match(
+                        tensors["sel_req_kv"],
+                        tensors["sel_exp_op"],
+                        tensors["sel_exp_key"],
+                        tensors["sel_exp_vals"],
+                        tensors["pod_kv"][lo : lo + chunk],
+                        tensors["pod_key"][lo : lo + chunk],
+                    )
+                )
+            )
+    if not outs:
+        return np.zeros((s, 0), dtype=bool)
+    return np.concatenate(outs, axis=1)
+
+
+# port_spec arrays are [P, ...]-shaped like the flat peer arrays
+_PEER_KEYS = (
+    "peer_kind",
+    "peer_ns_kind",
+    "peer_ns_id",
+    "peer_ns_sel",
+    "peer_pod_kind",
+    "peer_pod_sel",
+    "ip_base",
+    "ip_mask",
+    "ip_is_v4",
+    "ex_base",
+    "ex_mask",
+    "ex_valid",
+    "host_ip_mask",
+)
+
+
+def _compact_dead_targets(tensors: Dict) -> Dict:
+    """Drop targets that match no pod of this cluster (and their peers).
+
+    Verdicts are exactly invariant: a dead target's tmatch row is all
+    False (kernel.direction_precompute), so it contributes nothing to
+    has_target and nothing to any_allow.  But the target axis T is the
+    flops multiplier of every grid kernel — and in namespace-local policy
+    sets most compiled targets are dead ((ns, selector) combos with no
+    matching pods), so compaction shrinks the dominant matmuls by the
+    dead fraction.  Deadness is decided with the real selector kernel
+    (no heuristics), evaluated once on CPU at encode time: O(S * N),
+    noise next to the O(N^2 * T) evaluation it shrinks."""
+    pod_ns_id = tensors["pod_ns_id"]
+    selpod = _selector_pod_matches_host(tensors)
+    s = selpod.shape[0]
+    # rows: any ns id referenced by pods or targets (vocab ns ids can
+    # exceed the cluster's ns table when policies name pod-less namespaces)
+    n_rows = int(tensors["ns_kv"].shape[0])
+    for direction in ("ingress", "egress"):
+        t_ns = tensors[direction]["target_ns"]
+        if t_ns.size:
+            n_rows = max(n_rows, int(t_ns.max()) + 1)
+    if pod_ns_id.size:
+        n_rows = max(n_rows, int(pod_ns_id.max()) + 1)
+    # live_by_sel_ns[s, ns] = selector s matches >= 1 pod in namespace ns
+    live_by_sel_ns = np.zeros((s, max(n_rows, 1)), dtype=bool)
+    for si in range(s):
+        ids = pod_ns_id[selpod[si]]
+        if ids.size:
+            live_by_sel_ns[si, ids[ids >= 0]] = True
+
+    out = dict(tensors)
+    for direction in ("ingress", "egress"):
+        d = tensors[direction]
+        t_ns, t_sel = d["target_ns"], d["target_sel"]
+        t = t_ns.shape[0]
+        if t == 0:
+            continue
+        live = (t_ns >= 0) & live_by_sel_ns[t_sel, np.maximum(t_ns, 0)]
+        keep = np.flatnonzero(live)
+        if keep.size == t:
+            continue
+        remap = np.full(t, -1, dtype=np.int32)
+        remap[keep] = np.arange(keep.size, dtype=np.int32)
+        pt = d["peer_target"]
+        pkeep = (pt >= 0) & live[np.clip(pt, 0, t - 1)]
+        nd = dict(d)
+        nd["target_ns"] = np.ascontiguousarray(t_ns[keep])
+        nd["target_sel"] = np.ascontiguousarray(t_sel[keep])
+        nd["peer_target"] = np.ascontiguousarray(remap[pt[pkeep]])
+        for k in _PEER_KEYS:
+            if k in nd:
+                nd[k] = np.ascontiguousarray(nd[k][pkeep])
+        if "host_ip_match" in nd:
+            nd["host_ip_match"] = np.ascontiguousarray(nd["host_ip_match"][pkeep])
+        nd["port_spec"] = {
+            k: np.ascontiguousarray(v[pkeep]) for k, v in d["port_spec"].items()
+        }
+        out[direction] = nd
+    return out
+
+
+def _compaction_enabled(tensors: Dict) -> bool:
+    """Compaction is on by default (CYCLONUS_COMPACT=0 opts out), guarded
+    by a host-work budget: the CPU selector pass is O(S * N) with small
+    per-element constants — cap S * N so a pathological selector count
+    can't stall encode."""
+    import os
+
+    if os.environ.get("CYCLONUS_COMPACT", "1") == "0":
+        return False
+    s = int(tensors["sel_req_kv"].shape[0])
+    n = int(tensors["pod_ns_id"].shape[0])
+    return s * n <= 1 << 31
+
+
 def _pack_tensors(tree):
     """Pack a numpy pytree into one int32 buffer + an unpack function.
 
@@ -221,6 +347,9 @@ class TpuPolicyEngine:
         with phase("engine.encode"):
             self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
             self._tensors = self._build_tensors()
+            if _compaction_enabled(self._tensors):
+                with phase("engine.compact"):
+                    self._tensors = _compact_dead_targets(self._tensors)
         self._device_tensors = None  # lazily device_put once
         self._packed_buf = None  # single-buffer device copy (grid paths)
         self._unpack = None

@@ -43,11 +43,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # tile sizes: BS/BD are the src/dst tile heights (MXU-aligned), KT is the
-# target-axis chunk.  VMEM at these sizes: 4 input blocks x 1MB, double
+# MAX target-axis chunk.  VMEM at these sizes: 4 input blocks x 1MB, double
 # buffered, + 2MB scratch ~= 10MB of the ~16MB budget.
 BS = 512
 BD = 512
 KT = 1024
+
+
+def _kt_for(n_targets: int) -> int:
+    """Per-direction target-axis chunk: lane-aligned (128) and no larger
+    than needed.  Target counts after dead-target compaction are often
+    far below the max chunk (e.g. ~300 at the 10k-policy bench config);
+    padding them to a fixed 1024 would multiply both the contraction
+    depth (matmul flops) and the [Q, KT, N] operand's HBM footprint —
+    the single-chip memory ceiling at multi-million-pod scale."""
+    return max(128, min(KT, -(-max(n_targets, 1) // 128) * 128))
 
 
 def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
@@ -198,13 +208,15 @@ def verdict_counts_pallas(
     # trailing dst rows whenever BS != BD rounded differently)
     nb = math.lcm(BS, BD)
 
-    a_e = _pad_to(_pad_to(tmatch_e.astype(jnp.bfloat16), 0, KT), 1, nb).T
-    a_i = _pad_to(_pad_to(tmatch_i.astype(jnp.bfloat16), 0, KT), 1, nb)
+    kt_e = _kt_for(tmatch_e.shape[0])
+    kt_i = _kt_for(tmatch_i.shape[0])
+    a_e = _pad_to(_pad_to(tmatch_e.astype(jnp.bfloat16), 0, kt_e), 1, nb).T
+    a_i = _pad_to(_pad_to(tmatch_i.astype(jnp.bfloat16), 0, kt_i), 1, nb)
     b_e = _pad_to(
-        _pad_to(jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16), 1, KT), 2, nb
+        _pad_to(jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16), 1, kt_e), 2, nb
     )  # [Q, T_e', N']
     b_i = _pad_to(
-        _pad_to(jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16), 1, KT), 2, nb
+        _pad_to(jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16), 1, kt_i), 2, nb
     )  # [Q, T_i', N']
     has_e_p = _pad_to(has_e.astype(jnp.int32)[None, :], 1, nb)
     has_i_p = _pad_to(has_i.astype(jnp.int32)[None, :], 1, nb)
@@ -217,8 +229,8 @@ def verdict_counts_pallas(
     # direction's matmul past its n_k (saving the MXU time), and the
     # clamped index maps below keep the block fetch in bounds without
     # padding the shorter direction up (saving the HBM space + DMA)
-    n_k_e = b_e.shape[1] // KT
-    n_k_i = b_i.shape[1] // KT
+    n_k_e = b_e.shape[1] // kt_e
+    n_k_i = b_i.shape[1] // kt_i
 
     n_i = n_pad // BS
     # per-(q, src-tile) partial counts stay within int32: BS * n_pad
@@ -233,8 +245,8 @@ def verdict_counts_pallas(
     # content maps for the scalar-prefetch skip: which (pod-tile, T-chunk)
     # tmatch blocks hold any nonzero.  O(N*T) device reduction — noise
     # next to the O(N^2 T) matmuls it lets the kernel skip.
-    nz_e_mat = (a_e.reshape(n_i, BS, n_k_e, KT) != 0).any(axis=(1, 3))  # [n_i, n_k_e]
-    nz_i_mat = (a_i.reshape(n_k_i, KT, n_j, BD) != 0).any(axis=(1, 3))  # [n_k_i, n_j]
+    nz_e_mat = (a_e.reshape(n_i, BS, n_k_e, kt_e) != 0).any(axis=(1, 3))  # [n_i, n_k_e]
+    nz_i_mat = (a_i.reshape(n_k_i, kt_i, n_j, BD) != 0).any(axis=(1, 3))  # [n_k_i, n_j]
 
     # DMA-reuse redirects: for a skipped chunk, point every operand's
     # index map at the last USED chunk, so the pallas pipeline sees an
@@ -265,18 +277,18 @@ def verdict_counts_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (BS, KT), lambda q, i, j, k, ne, ni, re, ri: (i, re_(i, k, re))
+                (BS, kt_e), lambda q, i, j, k, ne, ni, re, ri: (i, re_(i, k, re))
             ),
             pl.BlockSpec(
-                (1, KT, BD),
+                (1, kt_e, BD),
                 lambda q, i, j, k, ne, ni, re, ri: (q, re_(i, k, re), j),
             ),
             pl.BlockSpec(
-                (1, KT, BS),
+                (1, kt_i, BS),
                 lambda q, i, j, k, ne, ni, re, ri: (q, ri_(j, k, ri), i),
             ),
             pl.BlockSpec(
-                (KT, BD), lambda q, i, j, k, ne, ni, re, ri: (ri_(j, k, ri), j)
+                (kt_i, BD), lambda q, i, j, k, ne, ni, re, ri: (ri_(j, k, ri), j)
             ),
             pl.BlockSpec((1, BS), lambda q, i, j, k, *_: (0, i)),
             pl.BlockSpec((1, BD), lambda q, i, j, k, *_: (0, j)),
