@@ -243,9 +243,17 @@ def main():
                 cases, block=block, backend=counts_backend
             )
 
+        from cyclonus_tpu.utils import tracing
+
+        tracing.reset()
         t0 = time.time()
         counts = run_tiled()
         t_warm = time.time() - t0
+        # what warmup is made of: single-buffer transfer vs trace+compile
+        # +first-execution (the engine.dispatch phase)
+        warm_phases = {
+            k: round(v["total_s"], 3) for k, v in tracing.stats().items()
+        }
         times = []
         for _ in range(3):
             t0 = time.time()
@@ -301,6 +309,7 @@ def main():
                         "encode_s": round(t_encode, 3),
                         "backend_init_s": round(t_init, 3),
                         "warmup_s": round(t_warm, 3),
+                        "warmup_phases": warm_phases,
                         "eval_s": round(t_eval, 4),
                         "allow_rate": round(allow_rate, 4),
                         "parity_spot_checks": n_samples,

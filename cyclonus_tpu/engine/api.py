@@ -142,33 +142,75 @@ def _direction_tensors(enc: _DirectionEncoding) -> Dict:
     return d
 
 
-def _selector_pod_matches_host(tensors: Dict, chunk: int = 65536) -> np.ndarray:
-    """[S, N] bool selector-vs-pod matches, evaluated on the CPU backend
-    in pod chunks (the [S, chunk, ...] broadcast intermediates stay
-    bounded).  Exact kernel semantics — this IS kernel.selector_match,
-    just run host-side so the result is available at encode time."""
-    import jax
+def _selector_match_np(
+    sel_req_kv: np.ndarray,  # [S, R]
+    sel_exp_op: np.ndarray,  # [S, E]
+    sel_exp_key: np.ndarray,  # [S, E]
+    sel_exp_vals: np.ndarray,  # [S, E, V]
+    kv: np.ndarray,  # [N, L]
+    key: np.ndarray,  # [N, L]
+) -> np.ndarray:
+    """[S, N] bool — numpy twin of kernel.selector_match, op for op.
 
-    from .kernel import selector_match
+    Pure numpy on purpose: the device twin would be routed to CPU with
+    jax.devices("cpu"), and that call BLOCKS on global backend init —
+    on a remote-attached TPU, encode would silently serialize behind
+    seconds of tunnel bring-up.  Twin equality is pinned by
+    tests/test_engine_pallas.py::test_selector_match_np_twin."""
+    from .encoding import EXP_EXISTS, EXP_IN, EXP_NONE, EXP_NOT_IN
 
-    cpu = jax.devices("cpu")[0]
+    present = np.any(
+        kv[None, :, None, :] == sel_req_kv[:, None, :, None], axis=-1
+    )
+    req_ok = np.all((sel_req_kv[:, None, :] == -1) | present, axis=-1)  # [S, N]
+
+    has_key = np.any(
+        key[None, :, None, :] == sel_exp_key[:, None, :, None], axis=-1
+    )  # [S, N, E]
+    val_hit = np.any(
+        (sel_exp_vals[:, None, :, :, None] != -1)
+        & (kv[None, :, None, None, :] == sel_exp_vals[:, None, :, :, None]),
+        axis=(-1, -2),
+    )  # [S, N, E]
+    op = sel_exp_op[:, None, :]  # [S, 1, E]
+    exp_ok = np.where(
+        op == EXP_NONE,
+        True,
+        np.where(
+            op == EXP_IN,
+            has_key & val_hit,
+            np.where(
+                op == EXP_NOT_IN,
+                has_key & ~val_hit,
+                np.where(op == EXP_EXISTS, has_key, ~has_key),
+            ),
+        ),
+    )  # [S, N, E]
+    return req_ok & np.all(exp_ok, axis=-1)
+
+
+def _selector_pod_matches_host(tensors: Dict, chunk: int = 0) -> np.ndarray:
+    """[S, N] bool selector-vs-pod matches, evaluated host-side in pod
+    chunks so the result is available at encode time without touching any
+    device.  The chunk scales inversely with the selector count so the
+    [S, chunk, ...] broadcast intermediates stay bounded in BOTH axes —
+    a fixed pod chunk would let a large selector table OOM the encode."""
     n = tensors["pod_kv"].shape[0]
     s = tensors["sel_req_kv"].shape[0]
+    if not chunk:
+        chunk = max(256, (1 << 24) // max(s, 1))
     outs = []
-    with jax.default_device(cpu):
-        for lo in range(0, n, chunk):
-            outs.append(
-                np.asarray(
-                    selector_match(
-                        tensors["sel_req_kv"],
-                        tensors["sel_exp_op"],
-                        tensors["sel_exp_key"],
-                        tensors["sel_exp_vals"],
-                        tensors["pod_kv"][lo : lo + chunk],
-                        tensors["pod_key"][lo : lo + chunk],
-                    )
-                )
+    for lo in range(0, n, chunk):
+        outs.append(
+            _selector_match_np(
+                tensors["sel_req_kv"],
+                tensors["sel_exp_op"],
+                tensors["sel_exp_key"],
+                tensors["sel_exp_vals"],
+                tensors["pod_kv"][lo : lo + chunk],
+                tensors["pod_key"][lo : lo + chunk],
             )
+        )
     if not outs:
         return np.zeros((s, 0), dtype=bool)
     return np.concatenate(outs, axis=1)
@@ -250,6 +292,37 @@ def _compact_dead_targets(tensors: Dict) -> Dict:
         nd["port_spec"] = {
             k: np.ascontiguousarray(v[pkeep]) for k, v in d["port_spec"].items()
         }
+        out[direction] = nd
+    return out
+
+
+def _sort_targets_by_ns(tensors: Dict) -> Dict:
+    """Permute each direction's targets into namespace order (stable).
+
+    Target order is semantically irrelevant — every kernel reduces over
+    the target axis — but with targets ns-sorted (and pods ns-sorted at
+    counts time) the tmatch matrices become near block diagonal, which
+    is what lets the pallas counts kernel skip empty (pod-tile, T-chunk)
+    blocks.  Sorting once in the base tensors means no per-path copy of
+    the target/peer arrays is ever needed."""
+    out = dict(tensors)
+    for direction in ("ingress", "egress"):
+        d = tensors[direction]
+        t_ns = d["target_ns"]
+        if t_ns.size == 0:
+            continue
+        tperm = np.argsort(t_ns, kind="stable")
+        if np.array_equal(tperm, np.arange(tperm.size)):
+            continue
+        inv = np.empty_like(tperm)
+        inv[tperm] = np.arange(tperm.size)
+        nd = dict(d)
+        nd["target_ns"] = np.ascontiguousarray(t_ns[tperm])
+        nd["target_sel"] = np.ascontiguousarray(d["target_sel"][tperm])
+        if d["peer_target"].size:
+            nd["peer_target"] = np.ascontiguousarray(
+                inv[d["peer_target"]].astype(np.int32)
+            )
         out[direction] = nd
     return out
 
@@ -350,11 +423,11 @@ class TpuPolicyEngine:
             if _compaction_enabled(self._tensors):
                 with phase("engine.compact"):
                     self._tensors = _compact_dead_targets(self._tensors)
+            self._tensors = _sort_targets_by_ns(self._tensors)
         self._device_tensors = None  # lazily device_put once
-        self._packed_buf = None  # single-buffer device copy (grid paths)
+        self._packed_buf = None  # single-buffer device copy (all paths)
         self._unpack = None
-        self._packed_sorted_buf = None  # ns-sorted variant (counts path)
-        self._unpack_sorted = None
+        self._pod_perm_dev = None  # ns-order pod permutation (counts path)
         self._counts_packed_jit = None
         self._has_ip_peers = (
             bool(np.any(self.encoding.ingress.peer_kind == PEER_IP))
@@ -523,71 +596,55 @@ class TpuPolicyEngine:
             self._tensors_with_cases(cases), n, block=block
         )
 
-    def _counts_tensors_sorted(self) -> Dict:
-        """Tensor dict with pods AND per-direction targets permuted into
-        namespace order — counts are invariant under both permutations.
-
-        Why: a target applies to pods of exactly one namespace, so with
-        both axes ns-sorted the tmatch matrices become (ragged) block
-        diagonal and most (pod-tile, target-chunk) blocks are ALL ZERO.
-        The pallas counts kernel detects those blocks on device and skips
-        their matmuls (scalar-prefetch nz maps) — the dominant flops term
-        drops from O(N^2 T) dense to the occupied blocks only.  Only the
-        counts path uses the sorted order; grid paths keep caller order."""
-        from .sharded import _POD_KEYS
-
-        t = dict(self._tensors)
-        perm = np.argsort(t["pod_ns_id"], kind="stable")
-        for k in _POD_KEYS:
-            t[k] = np.ascontiguousarray(t[k][perm])
-        for direction in ("ingress", "egress"):
-            d = dict(t[direction])
-            tperm = np.argsort(d["target_ns"], kind="stable")
-            inv = np.empty_like(tperm)
-            inv[tperm] = np.arange(tperm.size)
-            d["target_ns"] = np.ascontiguousarray(d["target_ns"][tperm])
-            d["target_sel"] = np.ascontiguousarray(d["target_sel"][tperm])
-            # peer_target holds TARGET indices: remap through the inverse
-            if d["peer_target"].size:
-                d["peer_target"] = np.ascontiguousarray(
-                    inv[d["peer_target"]].astype(np.int32)
-                )
-            if "host_ip_match" in d:
-                d["host_ip_match"] = np.ascontiguousarray(
-                    d["host_ip_match"][:, perm]
-                )
-            t[direction] = d
-        return t
-
-    def _ensure_packed_sorted(self):
-        """Packed device buffer of the ns-sorted tensors (counts path)."""
-        if self._packed_sorted_buf is None:
-            return self._packed_transfer(
-                "_packed_sorted_buf", "_unpack_sorted", self._counts_tensors_sorted()
-            )
-        return self._packed_sorted_buf
-
     def _counts_pallas_packed(self, cases: Sequence[PortCase], n: int) -> Dict[str, int]:
         """The fused pallas counts path over the SINGLE-BUFFER tensor
-        transfer: unpack + precompute + pallas counts all trace into one
-        jit, so a cold process pays one host->device transfer, one trace,
-        one (persistently cached) compile, and one execution — per-buffer
-        tunnel round trips and separate precompute dispatch disappear
-        from warmup.  Tensors are ns-sorted (see _counts_tensors_sorted)
-        so the kernel can skip empty target blocks."""
+        transfer: unpack + pod-axis ns-sort + precompute + pallas counts
+        all trace into one jit, so a cold process pays one host->device
+        transfer (shared with the grid/pairs paths), one trace, one
+        (persistently cached) compile, and one execution.
+
+        Why the sort: a target applies to pods of exactly one namespace,
+        so with pods ns-sorted (on device, via the permutation gather
+        below) and targets ns-sorted (in the base tensors —
+        _sort_targets_by_ns) the tmatch matrices become near block
+        diagonal and most (pod-tile, target-chunk) blocks are ALL ZERO;
+        the pallas kernel skips their matmuls (scalar-prefetch nz maps),
+        dropping the dominant flops term from O(N^2 T) dense to the
+        occupied blocks only.  Counts are invariant under both
+        permutations, so only this path sorts; grid paths keep caller
+        order."""
         import jax
 
-        buf = self._ensure_packed_sorted()
+        from .sharded import _POD_KEYS
+
+        buf = self._ensure_packed()
+        if self._pod_perm_dev is None:
+            perm = np.argsort(
+                self._tensors["pod_ns_id"], kind="stable"
+            ).astype(np.int32)
+            with phase("engine.device_put"):
+                self._pod_perm_dev = jax.device_put(perm)
         if self._counts_packed_jit is None:
             from .pallas_kernel import _should_interpret, verdict_counts_pallas
             from .tiled import _precompute
 
-            unpack = self._unpack_sorted
+            unpack = self._unpack
             interpret = _should_interpret()
 
             @jax.jit
-            def counts_packed(buf, q_port, q_name, q_proto, n_pods):
+            def counts_packed(buf, perm, q_port, q_name, q_proto, n_pods):
+                import jax.numpy as jnp
+
                 tensors = dict(unpack(buf))
+                for k in _POD_KEYS:
+                    tensors[k] = jnp.take(tensors[k], perm, axis=0)
+                for direction in ("ingress", "egress"):
+                    if "host_ip_match" in tensors[direction]:
+                        d = dict(tensors[direction])
+                        d["host_ip_match"] = jnp.take(
+                            d["host_ip_match"], perm, axis=1
+                        )
+                        tensors[direction] = d
                 tensors["q_port"] = q_port
                 tensors["q_name"] = q_name
                 tensors["q_proto"] = q_proto
@@ -609,7 +666,7 @@ class TpuPolicyEngine:
         q_port, q_name, q_proto = self._port_case_arrays(cases)
         with phase("engine.dispatch"):
             partials = self._counts_packed_jit(
-                buf, q_port, q_name, q_proto, np.int32(n)
+                buf, self._pod_perm_dev, q_port, q_name, q_proto, np.int32(n)
             )
         return sum_partials(partials, len(cases), n)
 

@@ -4,14 +4,18 @@ The XLA tiled counts path (tiled.py) materializes per-tile boolean verdict
 blocks and f32 matmul outputs in HBM before reducing them.  This kernel
 fuses the whole per-tile epilogue —
 
-    egress   = (tmatch_e_blk^T @ tallow_e) > 0  OR  no-egress-target
-    ingress  = (tallow_i_blk^T @ tmatch_i) > 0  OR  no-ingress-target
+    egress   = (tmatch_e_blk'^T @ tallow_e') > 0
+    ingress  = (tallow_i_blk'^T @ tmatch_i') > 0
     combined = egress AND ingress
-    counts  += [sum ingress, sum egress, sum combined]  (validity-masked)
+    counts  += [sum ingress, sum egress, sum combined]
 
 — into VMEM: a blocked matmul over grid (q, src-tile, dst-tile, T-chunk)
 with two f32 accumulators in scratch and a count epilogue on the last
 T-chunk.  The three N x N x Q verdict tensors never exist anywhere.
+The primed operands carry one extra PSEUDO-TARGET row per direction that
+encodes both the allow-if-no-matching-target rule and the pod-validity
+mask (verdict_counts_pallas docstring), so the epilogue needs no
+correction terms.
 
 Decision procedure mirrors tiled._tile_verdicts / kernel.py (reference
 policy.go:138-174); parity vs the XLA paths is enforced by
@@ -22,8 +26,8 @@ Layout notes:
     MXU, so the > 0 threshold is exact (0/1 inputs).
   * the pod axis is padded to the lane-aligned tile BD and the target
     axis to the chunk KT with zeros: padded targets match nothing and
-    allow nothing; padded pods carry valid=0 and are masked out of the
-    counts in the epilogue.
+    allow nothing; padded pods fail the pseudo-target's validity gate,
+    so their rows and columns count as zero with no explicit mask.
   * counts accumulate into a per-(port case, src-tile) int32 output block
     (the standard reduction-output pattern); lanes 0-2 hold ingress/
     egress/combined.  Per-block partials are bounded by BS * N, so they
@@ -68,7 +72,7 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
 
     Content skip: the nz_e/nz_i scalar-prefetch maps mark which
     (pod-tile, T-chunk) tmatch blocks contain any nonzero.  With pods
-    and targets namespace-sorted (api._counts_tensors_sorted) tmatch is
+    and targets namespace-sorted (api._counts_pallas_packed) tmatch is
     near block diagonal, so most blocks are empty and their matmuls are
     skipped entirely — this is where the 10k-policy regime's T-axis
     flops go."""
@@ -82,10 +86,6 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
         b_e_ref,  # [1, KT, BD] bf16  tallow_e (q, T-chunk k, dst block j)
         b_i_ref,  # [1, KT, BS] bf16  tallow_i (q, T-chunk k, src block i)
         a_i_ref,  # [KT, BD] bf16   tmatch_i (T-chunk k, dst block j)
-        has_e_ref,  # [1, BS] int32  src block i
-        has_i_ref,  # [1, BD] int32  dst block j
-        valid_s_ref,  # [1, BS] int32
-        valid_d_ref,  # [1, BD] int32
         counts_ref,  # [1, n_i, 128] int32: per-q count plane, row per src-tile
         acc_e_ref,  # [BS, BD] f32 scratch
         acc_i_ref,  # [BS, BD] f32 scratch
@@ -139,20 +139,20 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
 
         @pl.when(k == n_k - 1)
         def _epilogue():
-            # Mosaic can't reshape i1 vectors to 2D — route every row-direction
-            # broadcast through f32.  acc values are nonneg counts, so adding a
-            # huge constant where the pod has no target flips the > 0 verdict.
-            no_e = (has_e_ref[0, :] == 0).astype(jnp.float32)[:, None]  # [BS, 1]
-            no_i = (has_i_ref[0, :] == 0).astype(jnp.float32)  # [BD]
-            egress = (acc_e_ref[:] + no_e * 1e9) > 0.0
-            ingress = (acc_i_ref[:] + no_i[None, :] * 1e9) > 0.0
+            # The no-matching-target => allow rule and the pod validity
+            # mask are FOLDED INTO THE MATMUL as one pseudo-target row per
+            # direction (see verdict_counts_pallas): acc > 0 IS the final
+            # verdict, and invalid (padded) pods produce all-False rows/
+            # columns, so the counts need no masking.  This epilogue runs
+            # for every (src, dst) tile pair — at multi-million-pod scale
+            # its per-cell VPU work, not the MXU matmuls, is the kernel
+            # floor, so every fused op here was measured to matter.
+            egress = acc_e_ref[:] > 0.0
+            ingress = acc_i_ref[:] > 0.0
             combined = egress & ingress
-            vs = valid_s_ref[0, :].astype(jnp.float32)[:, None]  # [BS, 1]
-            vd = valid_d_ref[0, :].astype(jnp.float32)  # [BD]
-            mask = (vs * vd[None, :]) > 0.0
-            c_in = jnp.sum((ingress & mask).astype(jnp.int32))
-            c_eg = jnp.sum((egress & mask).astype(jnp.int32))
-            c_co = jnp.sum((combined & mask).astype(jnp.int32))
+            c_in = jnp.sum(ingress.astype(jnp.int32))
+            c_eg = jnp.sum(egress.astype(jnp.int32))
+            c_co = jnp.sum(combined.astype(jnp.int32))
             lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
             cnt_ref[:] += (
                 jnp.where(lane == 0, c_in, 0)
@@ -195,12 +195,33 @@ def verdict_counts_pallas(
     """[Q, n_src_tiles, 3] int32 partial allow counts (ingress, egress,
     combined) over the full N x N x Q grid, without materializing any
     verdict tensor.  Partials are per (port case, src tile) so each stays
-    below 2^31; sum them in int64 on the host."""
+    below 2^31; sum them in int64 on the host.
+
+    The allow-if-no-matching-target rule (reference policy.go:158-160)
+    and the pod-validity mask are folded into the contraction as ONE
+    PSEUDO-TARGET ROW per direction: the pseudo target "matches" exactly
+    the valid pods with no real target and "allows" exactly the valid
+    pods, so `acc > 0` is the complete verdict and invalid pods come out
+    all-False with no per-cell mask arithmetic.  That keeps the per-tile
+    epilogue — the VPU-bound floor of this kernel at large N — to two
+    compares, one AND, and three reductions."""
     n = tmatch_e.shape[1]
     q = tallow_e.shape[2]
     if n_pods is None:
         n_pods = n
-    valid = (jnp.arange(n) < n_pods).astype(jnp.int32)
+    valid = jnp.arange(n) < n_pods  # [N] bool
+    valid_bf = valid.astype(jnp.bfloat16)
+    valid_q = jnp.broadcast_to(valid_bf[None, None, :], (q, 1, n))
+
+    def _augment(tmatch, has, tallow_qtn):
+        """Append the pseudo-target row: matches valid no-target pods,
+        allows valid pods."""
+        pseudo_match = ((~has) & valid).astype(jnp.bfloat16)[None, :]
+        tmatch = jnp.concatenate(
+            [tmatch.astype(jnp.bfloat16), pseudo_match], axis=0
+        )
+        tallow_qtn = jnp.concatenate([tallow_qtn, valid_q], axis=1)
+        return tmatch, tallow_qtn
 
     # the pod axis appears as BOTH src tiles (BS) and dst tiles (BD):
     # pad every pod-axis operand to one common multiple so the two views
@@ -208,20 +229,18 @@ def verdict_counts_pallas(
     # trailing dst rows whenever BS != BD rounded differently)
     nb = math.lcm(BS, BD)
 
-    kt_e = _kt_for(tmatch_e.shape[0])
-    kt_i = _kt_for(tmatch_i.shape[0])
-    a_e = _pad_to(_pad_to(tmatch_e.astype(jnp.bfloat16), 0, kt_e), 1, nb).T
-    a_i = _pad_to(_pad_to(tmatch_i.astype(jnp.bfloat16), 0, kt_i), 1, nb)
-    b_e = _pad_to(
-        _pad_to(jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16), 1, kt_e), 2, nb
-    )  # [Q, T_e', N']
-    b_i = _pad_to(
-        _pad_to(jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16), 1, kt_i), 2, nb
-    )  # [Q, T_i', N']
-    has_e_p = _pad_to(has_e.astype(jnp.int32)[None, :], 1, nb)
-    has_i_p = _pad_to(has_i.astype(jnp.int32)[None, :], 1, nb)
-    valid_s = _pad_to(valid[None, :], 1, nb)
-    valid_d = _pad_to(valid[None, :], 1, nb)
+    tm_e, tl_e = _augment(
+        tmatch_e, has_e, jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16)
+    )
+    tm_i, tl_i = _augment(
+        tmatch_i, has_i, jnp.moveaxis(tallow_i, 2, 0).astype(jnp.bfloat16)
+    )
+    kt_e = _kt_for(tm_e.shape[0])
+    kt_i = _kt_for(tm_i.shape[0])
+    a_e = _pad_to(_pad_to(tm_e, 0, kt_e), 1, nb).T
+    a_i = _pad_to(_pad_to(tm_i, 0, kt_i), 1, nb)
+    b_e = _pad_to(_pad_to(tl_e, 1, kt_e), 2, nb)  # [Q, T_e', N']
+    b_i = _pad_to(_pad_to(tl_i, 1, kt_i), 2, nb)  # [Q, T_i', N']
 
     n_pad = a_e.shape[0]
     # the k grid dimension is shared, but each direction only has its OWN
@@ -290,10 +309,6 @@ def verdict_counts_pallas(
             pl.BlockSpec(
                 (kt_i, BD), lambda q, i, j, k, ne, ni, re, ri: (ri_(j, k, ri), j)
             ),
-            pl.BlockSpec((1, BS), lambda q, i, j, k, *_: (0, i)),
-            pl.BlockSpec((1, BD), lambda q, i, j, k, *_: (0, j)),
-            pl.BlockSpec((1, BS), lambda q, i, j, k, *_: (0, i)),
-            pl.BlockSpec((1, BD), lambda q, i, j, k, *_: (0, j)),
         ],
         out_specs=pl.BlockSpec((1, n_i, 128), lambda q, i, j, k, *_: (q, 0, 0)),
         scratch_shapes=[
@@ -311,12 +326,16 @@ def verdict_counts_pallas(
         # keeps the scheduler conservative rather than starving the
         # pipeline on the dense-tmatch (unsorted/adversarial) case
         cost_estimate=pl.CostEstimate(
-            flops=2 * q * n_pad * n_pad * (n_k_e + n_k_i) * KT,
-            bytes_accessed=2 * q * (n_pad // BS) * n_pad * (n_k_e + n_k_i) * KT,
+            flops=2 * q * n_pad * n_pad * (n_k_e * kt_e + n_k_i * kt_i),
+            bytes_accessed=2
+            * q
+            * (n_pad // BS)
+            * n_pad
+            * (n_k_e * kt_e + n_k_i * kt_i),
             transcendentals=0,
         ),
         interpret=interpret,
-    )(nz_e, nz_i, redir_e, redir_i, a_e, b_e, b_i, a_i, has_e_p, has_i_p, valid_s, valid_d)
+    )(nz_e, nz_i, redir_e, redir_i, a_e, b_e, b_i, a_i)
     # [Q, n_i, 3] int32 partials; the caller sums them in numpy int64
     # (jnp int64 silently truncates to int32 without jax_enable_x64)
     return counts[:, :, :3]

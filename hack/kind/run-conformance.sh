@@ -122,11 +122,20 @@ else
   # run (logs -f returns 0 even for a failed run, and can also return
   # early, so a short `kubectl wait` here would misreport healthy runs)
   verdict=timeout
+  kubectl_fails=0
   for _ in $(seq 1 "${JOB_POLLS:-360}"); do
+    # tolerate apiserver blips (control-plane restart, connection reset):
+    # only a sustained run of failed polls is a kubectl error
     if ! status=$(kubectl get job cyclonus -n netpol -o json 2>&1); then
-      verdict="kubectl-error: $status"
-      break
+      kubectl_fails=$((kubectl_fails + 1))
+      if [ "$kubectl_fails" -ge "${KUBECTL_FAIL_LIMIT:-6}" ]; then
+        verdict="kubectl-error: $status"
+        break
+      fi
+      sleep 10
+      continue
     fi
+    kubectl_fails=0
     complete=$(kubectl get job cyclonus -n netpol \
       -o jsonpath='{.status.conditions[?(@.type=="Complete")].status}' \
       2>/dev/null || true)

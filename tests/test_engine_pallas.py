@@ -86,6 +86,10 @@ class TestPallasCounts:
                 ))
             return out
 
+        # these fixtures' targets mostly match no pod; dead-target
+        # compaction would collapse them to a single chunk and make the
+        # multi-chunk path untested
+        monkeypatch.setenv("CYCLONUS_COMPACT", "0")
         # KT is a lane dimension (min 128); >128 targets on one side
         # yields n_k 2 vs 1
         monkeypatch.setattr(pk, "KT", 128)
@@ -125,3 +129,84 @@ class TestPallasCounts:
             # don't leave a non-default-tiling executable in the global
             # cache for later tests with identical input shapes
             jax.clear_caches()
+
+    def test_selector_match_np_twin(self):
+        """The numpy selector evaluator that drives dead-target compaction
+        must agree with the device kernel op for op — fuzzed over random
+        selector tables (incl. matchExpressions) and label sets."""
+        import numpy as np
+
+        from cyclonus_tpu.engine.api import _selector_match_np
+        from cyclonus_tpu.engine.kernel import selector_match
+
+        rng = np.random.default_rng(7)
+        for _ in range(20):
+            s, r, e, v, n, l = (
+                rng.integers(1, 6),
+                rng.integers(1, 4),
+                rng.integers(1, 4),
+                rng.integers(1, 4),
+                rng.integers(1, 12),
+                rng.integers(1, 5),
+            )
+            args = (
+                rng.integers(-1, 6, size=(s, r)).astype(np.int32),
+                rng.integers(0, 5, size=(s, e)).astype(np.int32),
+                rng.integers(-1, 5, size=(s, e)).astype(np.int32),
+                rng.integers(-1, 6, size=(s, e, v)).astype(np.int32),
+                rng.integers(-1, 6, size=(n, l)).astype(np.int32),
+                rng.integers(-1, 5, size=(n, l)).astype(np.int32),
+            )
+            got = _selector_match_np(*args)
+            want = np.asarray(selector_match(*args))
+            assert np.array_equal(got, want)
+
+    def test_no_policies_all_allow(self):
+        """With zero policies every pod is target-free: the pseudo-target
+        fold must produce all-allow counts for exactly the valid pods
+        (pads contribute nothing)."""
+        from cyclonus_tpu.matcher import build_network_policies
+
+        from test_engine_parity import default_cluster
+
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(True, [])
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        counts = engine.evaluate_grid_counts(CASES, backend="pallas")
+        n = len(pods)
+        full = n * n * len(CASES)
+        assert counts == {
+            "ingress": full,
+            "egress": full,
+            "combined": full,
+            "cells": full,
+        }
+
+    def test_one_empty_direction(self):
+        """Ingress-only policies leave the egress target axis empty
+        (T_e = 0): its padded pseudo-row chunk must still produce the
+        all-allow egress verdicts for valid pods."""
+        from cyclonus_tpu.kube.netpol import LabelSelector
+        from cyclonus_tpu.matcher import build_network_policies
+
+        from test_engine_parity import default_cluster, mkpol
+
+        pods, namespaces = default_cluster()
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "deny-in",
+                    "x",
+                    LabelSelector.make(match_labels={"pod": "a"}),
+                    ["Ingress"],
+                    ingress=[],
+                )
+            ],
+        )
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, block=8, backend="xla")
+        got = engine.evaluate_grid_counts(CASES, backend="pallas")
+        assert got == want
+        n = len(pods)
+        assert got["egress"] == n * n * len(CASES)  # no egress targets
