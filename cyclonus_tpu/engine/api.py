@@ -703,6 +703,23 @@ class TpuPolicyEngine:
             self._tensors_with_cases(cases), n, block=block, mesh=mesh
         )
 
+    def evaluate_grid_counts_ring2d(
+        self, cases: Sequence[PortCase], block: int = 1024, mesh=None
+    ) -> Dict[str, int]:
+        """Hierarchical multi-host ring counts over a ("dcn", "ici") mesh:
+        ring hops ride the intra-host ICI ring and cross the DCN host
+        boundary once per round (engine/tiled.py ring2d).  The multi-host
+        scale-out path."""
+        self._check_ips()
+        n = self.encoding.cluster.n_pods
+        if not cases or n == 0:
+            return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        from .tiled import evaluate_grid_counts_ring2d
+
+        return evaluate_grid_counts_ring2d(
+            self._tensors_with_cases(cases), n, block=block, mesh=mesh
+        )
+
     def iter_grid_blocks(self, cases: Sequence[PortCase], block: int = 1024):
         """Stream verdict blocks of source rows to the host:
         yields (start, ingress_rows, egress, combined), arrays [b, N, Q]

@@ -422,6 +422,121 @@ def evaluate_grid_counts_ring(
     )
 
 
+def evaluate_grid_counts_ring2d(
+    tensors: Dict, n_pods: int, block: int = 1024, mesh=None
+) -> Dict[str, int]:
+    """Hierarchical multi-host ring counts over a 2-D ("dcn", "ici") mesh.
+
+    Same math as evaluate_grid_counts_ring — both pod axes sharded, the
+    dst-side precompute bundle rotating — but the rotation is laid out
+    for multi-host topology: of every `n_dev` hops, all but one ride the
+    intra-host ICI ring; the bundle crosses the slow DCN boundary exactly
+    once per host round.  Device (h, c) still sees every shard exactly
+    once: at step j of round o it holds shard (h - o, c + o - j mod
+    n_ici) — j sweeps the host's chips within a round, o sweeps the
+    hosts — which enumerates the full (host, chip) torus.  The program
+    is a lax.fori_loop over the n_dcn rounds with only the n_ici-step
+    round body unrolled (collectives need static axis/perm, and a
+    full-ring unroll would scale trace/compile size with total device
+    count).
+
+    This is the scale-out story the reference's slot map (SURVEY.md
+    section 2.7/5) assigns to NCCL-style backends: XLA collectives over
+    ICI within a host, DCN across hosts, no host-side communication
+    code at all."""
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import default_mesh, pod_sharded_in_specs
+
+    if mesh is None:
+        # default: factor the flat device list into 2 "hosts" when even
+        # (so the DCN axis actually exercises on a virtual mesh)
+        devs = default_mesh().devices.reshape(-1)
+        n_hosts = 2 if devs.size % 2 == 0 and devs.size > 1 else 1
+        mesh = Mesh(devs.reshape(n_hosts, -1), ("dcn", "ici"))
+    if set(mesh.axis_names) != {"dcn", "ici"}:
+        raise ValueError(
+            f"ring2d needs a ('dcn', 'ici') mesh, got {mesh.axis_names}"
+        )
+    mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
+        tensors, n_pods, block, mesh
+    )
+    n_dcn, n_ici = (
+        mesh.shape["dcn"],
+        mesh.shape["ici"],
+    )
+    shard = n_padded // n_dev
+    tiles_per_shard = shard // block
+
+    def per_device(t):
+        pre = _precompute(t)
+        dev = jax.lax.axis_index("dcn") * n_ici + jax.lax.axis_index("ici")
+        row0 = dev * shard
+        valid_local = (jnp.arange(shard) + row0) < n_pods
+
+        src, dst0 = _split_pre(pre)
+        ring = dict(dst0, valid=valid_local)
+        counts = jnp.zeros((n_dev * tiles_per_shard, 3), dtype=jnp.int32)
+
+        def _hop(ring, axis, size):
+            perm = [(d, (d + 1) % size) for d in range(size)]
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, axis, perm), ring
+            )
+
+        def round_body(o, carry):
+            counts, ring = carry
+            # only the n_ici-step round body is traced; rounds ride the
+            # fori_loop so program size is independent of the host count
+            for j in range(n_ici):
+                dst = {k: ring[k] for k in ("tallow_e", "tmatch_i", "has_i")}
+
+                def tile(i, counts, _dst=dst, _rv=ring["valid"], _j=j):
+                    row = _tile_counts_split(
+                        src, _dst, valid_local, _rv, i * block, block
+                    )
+                    return counts.at[
+                        (o * n_ici + _j) * tiles_per_shard + i
+                    ].set(row)
+
+                counts = jax.lax.fori_loop(0, tiles_per_shard, tile, counts)
+                # all-but-one hop per round stays on ICI; the bundle
+                # crosses DCN once per round.  The last round's DCN hop
+                # is wasted work but kept unconditional: collectives
+                # under lax.cond don't lower reliably, and it is one
+                # transfer per run.
+                if j < n_ici - 1:
+                    ring = _hop(ring, "ici", n_ici)
+                else:
+                    ring = _hop(ring, "dcn", n_dcn)
+            return counts, ring
+
+        counts, _ = jax.lax.fori_loop(0, n_dcn, round_body, (counts, ring))
+        return jax.lax.all_gather(
+            jax.lax.all_gather(counts, "ici", axis=0, tiled=True),
+            "dcn",
+            axis=0,
+            tiled=True,
+        )
+
+    # pod arrays shard over the flattened (dcn, ici) device order
+    in_specs = pod_sharded_in_specs(tensors)
+
+    def _flatten_spec(spec):
+        if spec and spec != P():
+            parts = tuple(
+                ("dcn", "ici") if p == "x" else p for p in spec
+            )
+            return P(*parts)
+        return spec
+
+    in_specs = jax.tree_util.tree_map(
+        _flatten_spec, in_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    return _run_mesh_counts(per_device, mesh, in_specs, tensors, q, n_pods)
+
+
 def evaluate_grid_counts_sharded(
     tensors: Dict, n_pods: int, block: int = 1024, mesh=None
 ) -> Dict[str, int]:

@@ -97,6 +97,38 @@ class TestTiledCounts:
         assert counts["egress"] == int(egr.sum())
         assert counts["combined"] == int(comb.sum())
 
+    @pytest.mark.parametrize("seed,block", [(22, 2), (23, 8)])
+    def test_counts_ring2d_match_kernel(self, seed, block):
+        """Hierarchical (dcn, ici) ring counts — ICI hops within a host
+        round, one DCN hop per round — must equal the single-device
+        kernel's sums.  On the virtual 8-device CPU mesh the default
+        factoring is 2 hosts x 4 chips, so both axes actually rotate."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=13)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        counts = engine.evaluate_grid_counts_ring2d(CASES, block=block)
+        assert counts["ingress"] == int(ing.sum())
+        assert counts["egress"] == int(egr.sum())
+        assert counts["combined"] == int(comb.sum())
+
+    def test_counts_ring2d_explicit_mesh(self):
+        """A caller-provided 4x2 mesh (4 'hosts' x 2 'chips') exercises a
+        DCN axis longer than the ICI axis."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        policy, pods, namespaces = fuzz_problem(24, n_extra_pods=9)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        cpu = jax.devices("cpu")
+        if len(cpu) < 8:
+            pytest.skip(f"needs an 8-device CPU mesh, have {len(cpu)}")
+        devs = np.array(cpu[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("dcn", "ici"))
+        want = engine.evaluate_grid_counts(CASES, block=4, backend="xla")
+        got = engine.evaluate_grid_counts_ring2d(CASES, block=4, mesh=mesh)
+        assert got == want
+
     def test_counts_ring_ipv6_host_rows(self):
         """host_ip_match rows are pod-axis sharded in the ring path — on
         BOTH sides: the ingress policy patches the local (peer) view, the
