@@ -670,40 +670,54 @@ def random_policy(rng, idx, nss, keys, values):
     )
 
 
+def run_fuzz_seed(seed):
+    """One randomized cluster + policy set through assert_parity (oracle vs
+    single-device kernel and the tiled/pallas counts — see assert_parity)."""
+    rng = random.Random(seed)
+    nss = ["x", "y", "z"]
+    # key/value pools overlap with the namespace labels below, so random
+    # selectors genuinely discriminate between namespaces (a blind spot a
+    # review round found: ns-row misindexing was invisible to an earlier
+    # fuzzer whose selectors matched all-or-no namespaces)
+    keys = ["pod", "app", "tier", "ns", "team"]
+    values = ["a", "b", "c", "web", "db", "x", "y", "z", "blue", "red"]
+    namespaces = {
+        ns: {"ns": ns, "team": rng.choice(["blue", "red"])} for ns in nss
+    }
+    pods = []
+    ip = 1
+    for ns in nss:
+        for pname in ("a", "b", "c"):
+            labels = {"pod": pname}
+            if rng.random() < 0.5:
+                labels[rng.choice(keys)] = rng.choice(values)
+            pods.append((ns, pname, labels, f"192.168.{rng.randrange(2)}.{ip}"))
+            ip += 1
+    policies = [
+        random_policy(rng, i, nss, keys, values)
+        for i in range(rng.randrange(1, 6))
+    ]
+    policy = build_network_policies(True, policies)
+    cases = [
+        PortCase(80, "serve-80-tcp", "TCP"),
+        PortCase(81, "serve-81-udp", "UDP"),
+        PortCase(79, "", "SCTP"),
+    ]
+    assert_parity(policy, pods, namespaces, cases)
+
+
 class TestFuzzParity:
     @pytest.mark.parametrize("seed", range(12))
     def test_fuzz(self, seed):
-        rng = random.Random(seed)
-        nss = ["x", "y", "z"]
-        # key/value pools overlap with the namespace labels below, so random
-        # selectors genuinely discriminate between namespaces (a blind spot a
-        # review round found: ns-row misindexing was invisible to an earlier
-        # fuzzer whose selectors matched all-or-no namespaces)
-        keys = ["pod", "app", "tier", "ns", "team"]
-        values = ["a", "b", "c", "web", "db", "x", "y", "z", "blue", "red"]
-        namespaces = {
-            ns: {"ns": ns, "team": rng.choice(["blue", "red"])} for ns in nss
-        }
-        pods = []
-        ip = 1
-        for ns in nss:
-            for pname in ("a", "b", "c"):
-                labels = {"pod": pname}
-                if rng.random() < 0.5:
-                    labels[rng.choice(keys)] = rng.choice(values)
-                pods.append((ns, pname, labels, f"192.168.{rng.randrange(2)}.{ip}"))
-                ip += 1
-        policies = [
-            random_policy(rng, i, nss, keys, values)
-            for i in range(rng.randrange(1, 6))
-        ]
-        policy = build_network_policies(True, policies)
-        cases = [
-            PortCase(80, "serve-80-tcp", "TCP"),
-            PortCase(81, "serve-81-udp", "UDP"),
-            PortCase(79, "", "SCTP"),
-        ]
-        assert_parity(policy, pods, namespaces, cases)
+        run_fuzz_seed(seed)
+
+    @pytest.mark.fuzz
+    @pytest.mark.parametrize("seed", range(12, 112))
+    def test_fuzz_extended(self, seed):
+        """Opt-in deep sweep (pytest -m fuzz): 100 more seeds through the
+        same oracle-vs-engines parity gate — the 'fuzz continuously'
+        discipline SURVEY.md's hard-parts list calls for."""
+        run_fuzz_seed(seed)
 
     @pytest.mark.parametrize("seed", [0, 5, 9])
     def test_fuzz_sharded_matches_oracle(self, seed):
