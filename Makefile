@@ -13,6 +13,11 @@ check: vet
 conformance:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m conformance
 
+# opt-in: 100 extra randomized parity seeds through the grid kernel
+# and the xla/pallas counts engines
+fuzz:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fuzz
+
 bench:
 	python bench.py
 
@@ -29,4 +34,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance bench fmt vet cyclonus docker
+.PHONY: test check conformance fuzz bench fmt vet cyclonus docker

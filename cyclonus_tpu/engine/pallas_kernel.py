@@ -30,25 +30,28 @@ Layout notes:
     so their rows and columns count as zero with no explicit mask.
   * counts accumulate into a per-(port case, src-tile) int32 output block
     (the standard reduction-output pattern); lanes 0-2 hold ingress/
-    egress/combined.  Per-block partials are bounded by BS * N, so they
-    cannot overflow int32 below ~4M pods; the host sums them in int64
-    (a single global int32 accumulator overflowed at 100k pods).
+    egress/combined.  Per-block partials are bounded by bs * N with bs
+    chosen by _tiles_for (512 or 1024), which checks exactly this bound
+    before doubling; the host sums them in int64 (a single global int32
+    accumulator overflowed at 100k pods).
 """
 
 from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# tile sizes: BS/BD are the src/dst tile heights (MXU-aligned), KT is the
-# MAX target-axis chunk.  VMEM at these sizes: 4 input blocks x 1MB, double
-# buffered, + 2MB scratch ~= 10MB of the ~16MB budget.
+# base tile sizes: BS/BD are the src/dst tile heights (MXU-aligned), KT
+# the MAX target-axis chunk.  The actual per-call sizes come from
+# _kt_for (shrinks KT to the live target count) and _tiles_for (doubles
+# the src tile to 1024 when the smaller chunks leave VMEM room) — the
+# VMEM/overflow budgets live in those two functions.
 BS = 512
 BD = 512
 KT = 1024
@@ -62,6 +65,27 @@ def _kt_for(n_targets: int) -> int:
     depth (matmul flops) and the [Q, KT, N] operand's HBM footprint —
     the single-chip memory ceiling at multi-million-pod scale."""
     return max(128, min(KT, -(-max(n_targets, 1) // 128) * 128))
+
+
+def _tiles_for(kt_e: int, kt_i: int, n: int) -> Tuple[int, int]:
+    """Src/dst tile heights.  From the default (512, 512), double the src
+    tile when (a) the T-chunks leave VMEM room for the bigger blocks +
+    scratch and (b) per-(q, src-tile) int32 count partials stay below
+    2^31 — fewer grid steps amortize the per-step epilogue/DMA overhead
+    (measured 56 -> 63 e9 cells/s at the 100k x 10k config).  A
+    non-default BS/BD (tests sweep them) is honored as-is."""
+    bs, bd = BS, BD
+    if (bs, bd) != (512, 512):
+        return bs, bd
+    blocks = 4 * (kt_e + kt_i) * (2 * bs + bd)  # bf16, double-buffered
+    scratch = 2 * 4 * (2 * bs) * bd  # two f32 accumulators
+    if (
+        n > bs  # a single default tile already holds the whole problem
+        and blocks + scratch <= 12 * 2**20
+        and 2 * bs * (n + 2048) < 2**31
+    ):
+        bs *= 2
+    return bs, bd
 
 
 def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
@@ -100,7 +124,7 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
         # counts accumulate into a per-(q, src-tile) ROW of the per-q count
         # plane: a single global accumulator overflows int32 once allowed
         # cells exceed 2^31 (seen at 100k pods); per-row partials are bounded
-        # by BS * N < 2^31.  (The plane is the output block — a (1, 1, 128)
+        # by the _tiles_for-checked bs * N < 2^31.  (The plane is the output block — a (1, 1, 128)
         # block would violate the Mosaic (8, 128) tiling rule for n_i > 1.)
         @pl.when((i == 0) & (j == 0) & (k == 0))
         def _init_counts():
@@ -223,12 +247,6 @@ def verdict_counts_pallas(
         tallow_qtn = jnp.concatenate([tallow_qtn, valid_q], axis=1)
         return tmatch, tallow_qtn
 
-    # the pod axis appears as BOTH src tiles (BS) and dst tiles (BD):
-    # pad every pod-axis operand to one common multiple so the two views
-    # agree on n_pad (padding src and dst independently silently dropped
-    # trailing dst rows whenever BS != BD rounded differently)
-    nb = math.lcm(BS, BD)
-
     tm_e, tl_e = _augment(
         tmatch_e, has_e, jnp.moveaxis(tallow_e, 2, 0).astype(jnp.bfloat16)
     )
@@ -237,6 +255,12 @@ def verdict_counts_pallas(
     )
     kt_e = _kt_for(tm_e.shape[0])
     kt_i = _kt_for(tm_i.shape[0])
+    bs, bd = _tiles_for(kt_e, kt_i, n)
+    # the pod axis appears as BOTH src tiles (bs) and dst tiles (bd):
+    # pad every pod-axis operand to one common multiple so the two views
+    # agree on n_pad (padding src and dst independently silently dropped
+    # trailing dst rows whenever bs != bd rounded differently)
+    nb = math.lcm(bs, bd)
     a_e = _pad_to(_pad_to(tm_e, 0, kt_e), 1, nb).T
     a_i = _pad_to(_pad_to(tm_i, 0, kt_i), 1, nb)
     b_e = _pad_to(_pad_to(tl_e, 1, kt_e), 2, nb)  # [Q, T_e', N']
@@ -251,21 +275,21 @@ def verdict_counts_pallas(
     n_k_e = b_e.shape[1] // kt_e
     n_k_i = b_i.shape[1] // kt_i
 
-    n_i = n_pad // BS
-    # per-(q, src-tile) partial counts stay within int32: BS * n_pad
+    n_i = n_pad // bs
+    # per-(q, src-tile) partial counts stay within int32: bs * n_pad
     # allowed cells max per block (raise, not assert — this runtime size
     # guard must survive python -O)
-    if BS * n_pad >= 2**31:
+    if bs * n_pad >= 2**31:
         raise ValueError(
-            f"pod axis {n_pad} too large for int32 tile counts at BS={BS}"
+            f"pod axis {n_pad} too large for int32 tile counts at bs={bs}"
         )
-    n_j = n_pad // BD
+    n_j = n_pad // bd
     grid = (q, n_i, n_j, max(n_k_e, n_k_i))
     # content maps for the scalar-prefetch skip: which (pod-tile, T-chunk)
     # tmatch blocks hold any nonzero.  O(N*T) device reduction — noise
     # next to the O(N^2 T) matmuls it lets the kernel skip.
-    nz_e_mat = (a_e.reshape(n_i, BS, n_k_e, kt_e) != 0).any(axis=(1, 3))  # [n_i, n_k_e]
-    nz_i_mat = (a_i.reshape(n_k_i, kt_i, n_j, BD) != 0).any(axis=(1, 3))  # [n_k_i, n_j]
+    nz_e_mat = (a_e.reshape(n_i, bs, n_k_e, kt_e) != 0).any(axis=(1, 3))  # [n_i, n_k_e]
+    nz_i_mat = (a_i.reshape(n_k_i, kt_i, n_j, bd) != 0).any(axis=(1, 3))  # [n_k_i, n_j]
 
     # DMA-reuse redirects: for a skipped chunk, point every operand's
     # index map at the last USED chunk, so the pallas pipeline sees an
@@ -296,24 +320,24 @@ def verdict_counts_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (BS, kt_e), lambda q, i, j, k, ne, ni, re, ri: (i, re_(i, k, re))
+                (bs, kt_e), lambda q, i, j, k, ne, ni, re, ri: (i, re_(i, k, re))
             ),
             pl.BlockSpec(
-                (1, kt_e, BD),
+                (1, kt_e, bd),
                 lambda q, i, j, k, ne, ni, re, ri: (q, re_(i, k, re), j),
             ),
             pl.BlockSpec(
-                (1, kt_i, BS),
+                (1, kt_i, bs),
                 lambda q, i, j, k, ne, ni, re, ri: (q, ri_(j, k, ri), i),
             ),
             pl.BlockSpec(
-                (kt_i, BD), lambda q, i, j, k, ne, ni, re, ri: (ri_(j, k, ri), j)
+                (kt_i, bd), lambda q, i, j, k, ne, ni, re, ri: (ri_(j, k, ri), j)
             ),
         ],
         out_specs=pl.BlockSpec((1, n_i, 128), lambda q, i, j, k, *_: (q, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((BS, BD), jnp.float32),
-            pltpu.VMEM((BS, BD), jnp.float32),
+            pltpu.VMEM((bs, bd), jnp.float32),
+            pltpu.VMEM((bs, bd), jnp.float32),
             pltpu.VMEM((1, 128), jnp.int32),
         ],
     )
@@ -329,7 +353,7 @@ def verdict_counts_pallas(
             flops=2 * q * n_pad * n_pad * (n_k_e * kt_e + n_k_i * kt_i),
             bytes_accessed=2
             * q
-            * (n_pad // BS)
+            * (n_pad // bs)
             * n_pad
             * (n_k_e * kt_e + n_k_i * kt_i),
             transcendentals=0,
@@ -364,7 +388,8 @@ def sum_partials(partials, q: int, n_pods: int) -> Dict[str, int]:
 def evaluate_grid_counts_pallas(tensors: Dict, n_pods: int) -> Dict[str, int]:
     """Drop-in alternative to tiled.evaluate_grid_counts riding the fused
     Pallas kernel.  Per-(port case, src-tile) partials are int32-bounded
-    (BS * N < 2^31, checked); totals are summed host-side in int64."""
+    (bs * N < 2^31, checked in _tiles_for and again at call time); totals
+    are summed host-side in int64."""
     from .tiled import _precompute_jit
 
     pre = _precompute_jit(tensors)

@@ -76,7 +76,7 @@ def oracle_grid(policy, pods, namespaces, cases):
     return results
 
 
-def assert_parity(policy, pods, namespaces, cases, sharded=False):
+def assert_parity(policy, pods, namespaces, cases, sharded=False, counts=False):
     engine = TpuPolicyEngine(policy, pods, namespaces)
     if sharded:
         grid = engine.evaluate_grid_sharded(cases)
@@ -92,6 +92,19 @@ def assert_parity(policy, pods, namespaces, cases, sharded=False):
                  (exp_in, exp_eg, exp_comb), (got_in, got_eg, got_comb))
             )
     assert not mismatches, f"{len(mismatches)} mismatches, first 5: {mismatches[:5]}"
+    if counts:
+        # the counts engines must agree with the (oracle-checked) grid sums
+        import numpy as np
+
+        want = {
+            "ingress": int(np.asarray(grid.ingress).sum()),
+            "egress": int(np.asarray(grid.egress).sum()),
+            "combined": int(np.asarray(grid.combined).sum()),
+        }
+        for backend in ("xla", "pallas"):
+            got = engine.evaluate_grid_counts(cases, block=8, backend=backend)
+            got = {k: got[k] for k in want}
+            assert got == want, f"{backend} counts: {got} != {want}"
 
 
 def default_cluster():
@@ -670,9 +683,10 @@ def random_policy(rng, idx, nss, keys, values):
     )
 
 
-def run_fuzz_seed(seed):
-    """One randomized cluster + policy set through assert_parity (oracle vs
-    single-device kernel and the tiled/pallas counts — see assert_parity)."""
+def run_fuzz_seed(seed, counts=False):
+    """One randomized cluster + policy set through assert_parity: oracle vs
+    the single-device kernel, plus (counts=True, the extended sweep) the
+    xla and pallas counts engines against the oracle-checked grid sums."""
     rng = random.Random(seed)
     nss = ["x", "y", "z"]
     # key/value pools overlap with the namespace labels below, so random
@@ -703,7 +717,7 @@ def run_fuzz_seed(seed):
         PortCase(81, "serve-81-udp", "UDP"),
         PortCase(79, "", "SCTP"),
     ]
-    assert_parity(policy, pods, namespaces, cases)
+    assert_parity(policy, pods, namespaces, cases, counts=counts)
 
 
 class TestFuzzParity:
@@ -715,9 +729,10 @@ class TestFuzzParity:
     @pytest.mark.parametrize("seed", range(12, 112))
     def test_fuzz_extended(self, seed):
         """Opt-in deep sweep (pytest -m fuzz): 100 more seeds through the
-        same oracle-vs-engines parity gate — the 'fuzz continuously'
-        discipline SURVEY.md's hard-parts list calls for."""
-        run_fuzz_seed(seed)
+        oracle-vs-kernel gate AND the xla/pallas counts engines — the
+        'fuzz continuously' discipline SURVEY.md's hard-parts list calls
+        for."""
+        run_fuzz_seed(seed, counts=True)
 
     @pytest.mark.parametrize("seed", [0, 5, 9])
     def test_fuzz_sharded_matches_oracle(self, seed):
