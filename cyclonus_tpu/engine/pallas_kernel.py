@@ -72,7 +72,7 @@ def _tiles_for(kt_e: int, kt_i: int, n: int) -> Tuple[int, int]:
     tile when (a) the T-chunks leave VMEM room for the bigger blocks +
     scratch and (b) per-(q, src-tile) int32 count partials stay below
     2^31 — fewer grid steps amortize the per-step epilogue/DMA overhead
-    (measured 56 -> 63 e9 cells/s at the 100k x 10k config).  A
+    (bench-measured 56 -> 68 e9 cells/s at the 100k x 10k config).  A
     non-default BS/BD (tests sweep them) is honored as-is."""
     bs, bd = BS, BD
     if (bs, bd) != (512, 512):

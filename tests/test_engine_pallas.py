@@ -130,6 +130,28 @@ class TestPallasCounts:
             # cache for later tests with identical input shapes
             jax.clear_caches()
 
+    def test_doubled_src_tile_path(self):
+        """A >512-pod cluster with small T-chunks takes the bs=1024
+        doubled-src-tile configuration (_tiles_for) — the asymmetric
+        bs != bd index maps, nz reshapes, and epilogue flush must still
+        count exactly (every other test cluster is far below one tile)."""
+        import random
+
+        import bench as bench_mod
+        from cyclonus_tpu.engine.pallas_kernel import _tiles_for
+        from cyclonus_tpu.matcher import build_network_policies
+
+        rng = random.Random(31)
+        pods, namespaces, policies = bench_mod.build_synthetic(600, 60, rng)
+        policy = build_network_policies(True, policies)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        for d in ("ingress", "egress"):
+            assert engine._tensors[d]["target_ns"].shape[0] + 1 <= 128
+        assert _tiles_for(128, 128, 600) == (1024, 512)  # the tested config
+        want = engine.evaluate_grid_counts(CASES, block=64, backend="xla")
+        got = engine.evaluate_grid_counts(CASES, backend="pallas")
+        assert got == want
+
     def test_selector_match_np_twin(self):
         """The numpy selector evaluator that drives dead-target compaction
         must agree with the device kernel op for op — fuzzed over random
