@@ -327,6 +327,145 @@ def _sort_targets_by_ns(tensors: Dict) -> Dict:
     return out
 
 
+def _bucket_dim(n: int, lo: int = 4) -> int:
+    """Shape bucket: next power of two up to 128, then multiples of 128
+    (pod axis uses _bucket_pods).  Every distinct tensor shape costs a
+    fresh XLA compile; the 216 conformance clusters differ by a few
+    selectors/targets each, so exact sizing recompiled the engine per
+    test case — bucketing collapses them onto a handful of programs.
+    Above 128 the granule stays at 128 (the kernels' lane alignment):
+    pow2 there would pad the target axis far past the pallas kernel's
+    own chunk rounding and measurably deepen the contraction."""
+    n = max(n, lo)
+    if n <= 128:
+        return 1 << (n - 1).bit_length()
+    return -(-n // 128) * 128
+
+
+def _bucket_pods(n: int) -> int:
+    """Pod-axis bucket: pow2 up to 1024, then multiples of 1024 (matches
+    the tile block, and keeps large-N padding waste under ~0.1%)."""
+    n = max(n, 8)
+    if n <= 1024:
+        return 1 << (n - 1).bit_length()
+    return -(-n // 1024) * 1024
+
+
+def _pad_axis(a: np.ndarray, axis: int, size: int, fill) -> np.ndarray:
+    """Pad `axis` up to `size` with `fill` (no-op when already there)."""
+    cur = a.shape[axis]
+    if cur >= size:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, size - cur)
+    return np.pad(a, widths, constant_values=fill)
+
+
+# (array key, per-axis fill values) — the inert pad conventions from
+# encoding.py's padding-neutrality invariants: padded selectors are
+# unreferenced, padded targets match no pod (ns -1), padded peers belong
+# to target -1 (zero one-hot row), padded port items/ranges match nothing
+_DIRECTION_PADS = {
+    "target_ns": -1,
+    "target_sel": 0,
+    "peer_target": -1,
+    "peer_kind": 0,
+    "peer_ns_kind": 0,
+    "peer_ns_id": -1,
+    "peer_ns_sel": 0,
+    "peer_pod_kind": 0,
+    "peer_pod_sel": 0,
+    "ip_base": 0,
+    "ip_mask": 0,
+    "ip_is_v4": False,
+    "ex_base": 0,
+    "ex_mask": 0,
+    "ex_valid": False,
+    "host_ip_mask": False,
+    "host_ip_match": False,
+}
+_PORT_SPEC_PADS = {
+    "item_kind": -1,
+    "item_port": 0,
+    "item_name": -2,
+    "item_proto": -2,
+    "rng_from": 0,
+    "rng_to": -1,
+    "rng_proto": -2,
+    "spec_all": False,
+}
+
+
+def _bucket_tensors(tensors: Dict) -> Dict:
+    """Pad every tensor dimension up to its shape bucket with the inert
+    fill for that array, so near-identical problems share compiled
+    programs.  Semantics are unchanged by construction: each pad value is
+    the same inert encoding the encoder itself uses for ragged padding
+    (verified by the parity suites, which run everything bucketed)."""
+    from .sharded import _pad_pod_arrays
+
+    t = dict(tensors)
+    # selector tables: rows are unreferenced when padded
+    s = _bucket_dim(t["sel_req_kv"].shape[0])
+    t["sel_req_kv"] = _pad_axis(
+        _pad_axis(t["sel_req_kv"], 1, _bucket_dim(t["sel_req_kv"].shape[1]), -1),
+        0, s, -1,
+    )
+    t["sel_exp_op"] = _pad_axis(
+        _pad_axis(t["sel_exp_op"], 1, _bucket_dim(t["sel_exp_op"].shape[1]), 0),
+        0, s, 0,
+    )
+    t["sel_exp_key"] = _pad_axis(
+        _pad_axis(t["sel_exp_key"], 1, _bucket_dim(t["sel_exp_key"].shape[1]), -1),
+        0, s, -1,
+    )
+    ev = t["sel_exp_vals"]
+    t["sel_exp_vals"] = _pad_axis(
+        _pad_axis(
+            _pad_axis(ev, 2, _bucket_dim(ev.shape[2]), -1),
+            1, _bucket_dim(ev.shape[1]), -1,
+        ),
+        0, s, -1,
+    )
+    # namespace tables: padded rows are unreferenced (ns ids are real)
+    m = _bucket_dim(t["ns_kv"].shape[0])
+    for k in ("ns_kv", "ns_key"):
+        t[k] = _pad_axis(
+            _pad_axis(t[k], 1, _bucket_dim(t[k].shape[1]), -1), 0, m, -1
+        )
+    # pod label columns
+    for k in ("pod_kv", "pod_key"):
+        t[k] = _pad_axis(t[k], 1, _bucket_dim(t[k].shape[1]), -1)
+    # per-direction policy tensors
+    for direction in ("ingress", "egress"):
+        d = dict(t[direction])
+        # the pallas counts path appends ONE pseudo-target row
+        # (pallas_kernel._augment): bucket to boundary - 1 so the
+        # augmented axis lands exactly on the 128 chunk boundary instead
+        # of spilling a whole extra chunk into the contraction
+        nt = _bucket_dim(d["target_ns"].shape[0] + 1) - 1
+        np_ = _bucket_dim(d["peer_kind"].shape[0])
+        for k, fill in _DIRECTION_PADS.items():
+            if k not in d:
+                continue
+            size = nt if k.startswith("target_") else np_
+            d[k] = _pad_axis(d[k], 0, size, fill)
+            if k in ("ex_base", "ex_mask", "ex_valid"):
+                d[k] = _pad_axis(d[k], 1, _bucket_dim(d[k].shape[1]), fill)
+        spec = {}
+        for k, fill in _PORT_SPEC_PADS.items():
+            a = _pad_axis(d["port_spec"][k], 0, np_, fill)
+            if a.ndim == 2:
+                a = _pad_axis(a, 1, _bucket_dim(a.shape[1]), fill)
+            spec[k] = a
+        d["port_spec"] = spec
+        t[direction] = d
+    # pod axis last: the inert-row scheme lives in _pad_pod_arrays
+    n = t["pod_ns_id"].shape[0]
+    t, _ = _pad_pod_arrays(t, n, _bucket_pods(n))
+    return t
+
+
 def _compaction_enabled(tensors: Dict) -> bool:
     """Compaction is on by default (CYCLONUS_COMPACT=0 opts out), guarded
     by a host-work budget: the CPU selector pass is O(S * N) with small
@@ -423,7 +562,7 @@ class TpuPolicyEngine:
             if _compaction_enabled(self._tensors):
                 with phase("engine.compact"):
                     self._tensors = _compact_dead_targets(self._tensors)
-            self._tensors = _sort_targets_by_ns(self._tensors)
+            self._tensors = _bucket_tensors(_sort_targets_by_ns(self._tensors))
         self._device_tensors = None  # lazily device_put once
         self._packed_buf = None  # single-buffer device copy (all paths)
         self._unpack = None
@@ -519,13 +658,16 @@ class TpuPolicyEngine:
         # device execution time lands in grid.fetch / allow_stats
         with phase("engine.dispatch"):
             out = evaluate_grid_kernel(tensors)
-        # kernel emits [q, ...] layout directly: one device execution total
+        # kernel emits [q, ...] layout directly: one device execution
+        # total.  Bucketing pads the pod axis; the lazy device slice
+        # strips the pad rows so GridVerdict stays exactly n x n.
+        n = self.encoding.cluster.n_pods
         return GridVerdict(
             self.pod_keys,
             list(cases),
-            out["ingress"],
-            out["egress"],
-            out["combined"],
+            out["ingress"][:, :n, :n],
+            out["egress"][:, :n, :n],
+            out["combined"][:, :n, :n],
         )
 
     def _packed_transfer(self, buf_attr: str, unpack_attr: str, tensors: Dict):
@@ -619,9 +761,11 @@ class TpuPolicyEngine:
 
         buf = self._ensure_packed()
         if self._pod_perm_dev is None:
-            perm = np.argsort(
-                self._tensors["pod_ns_id"], kind="stable"
-            ).astype(np.int32)
+            # bucketing pads carry ns id -1: keep them LAST (the kernel's
+            # validity mask assumes real pods occupy the first n rows)
+            ns = self._tensors["pod_ns_id"]
+            key = np.where(ns < 0, np.iinfo(np.int32).max, ns)
+            perm = np.argsort(key, kind="stable").astype(np.int32)
             with phase("engine.device_put"):
                 self._pod_perm_dev = jax.device_put(perm)
         if self._counts_packed_jit is None:

@@ -238,12 +238,16 @@ def verdict_counts_pallas(
     valid_q = jnp.broadcast_to(valid_bf[None, None, :], (q, 1, n))
 
     def _augment(tmatch, has, tallow_qtn):
-        """Append the pseudo-target row: matches valid no-target pods,
-        allows valid pods."""
+        """Append the pseudo-target row (matches valid no-target pods,
+        allows valid pods) and zero the pad-pod columns of tallow:
+        kind-ALL / 0.0.0.0-0 peers match EVERY pod including the inert
+        pads the pod axis arrives with (shape bucketing pads before the
+        precompute), and an unmasked pad column would count as allowed."""
         pseudo_match = ((~has) & valid).astype(jnp.bfloat16)[None, :]
         tmatch = jnp.concatenate(
             [tmatch.astype(jnp.bfloat16), pseudo_match], axis=0
         )
+        tallow_qtn = tallow_qtn * valid_bf[None, None, :]
         tallow_qtn = jnp.concatenate([tallow_qtn, valid_q], axis=1)
         return tmatch, tallow_qtn
 

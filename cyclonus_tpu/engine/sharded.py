@@ -120,11 +120,15 @@ def default_mesh() -> Mesh:
 
 def _pad_pod_arrays(tensors: Dict, n_pods: int, n_dev: int) -> Tuple[Dict, int]:
     """Pad the pod axis to a multiple of the device count with inert rows
-    (ns id -1, labels -1, invalid ip): they match no target and no peer."""
-    padded = math.ceil(max(n_pods, 1) / n_dev) * n_dev
-    if padded == n_pods:
-        return tensors, n_pods
-    pad = padded - n_pods
+    (ns id -1, labels -1, invalid ip): they match no target and no peer.
+    The arrays may already be LONGER than n_pods (shape bucketing pads
+    them with the same inert rows at build time) — the current length,
+    not n_pods, is what gets rounded up."""
+    cur = int(tensors["pod_ns_id"].shape[0])
+    padded = math.ceil(max(cur, n_pods, 1) / n_dev) * n_dev
+    if padded == cur:
+        return tensors, cur
+    pad = padded - cur
     t = dict(tensors)
     t["pod_ns_id"] = np.concatenate(
         [tensors["pod_ns_id"], np.full((pad,), -1, np.int32)]

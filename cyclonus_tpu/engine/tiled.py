@@ -195,8 +195,10 @@ def _pad_pod_axis(tensors: Dict, n_pods: int, block: int) -> Tuple[Dict, int]:
     peer, so their verdicts are all-allow rows that get masked/stripped)."""
     from .sharded import _pad_pod_arrays
 
-    n_tiles = math.ceil(max(n_pods, 1) / block)
-    return _pad_pod_arrays(tensors, n_pods, n_tiles * block)[0], n_tiles
+    # n_tiles comes from the FINAL padded length: the arrays may arrive
+    # longer than n_pods from build-time shape bucketing
+    tensors, padded = _pad_pod_arrays(tensors, n_pods, block)
+    return tensors, padded // block
 
 
 def _tile_counts_split(
@@ -294,6 +296,10 @@ def iter_grid_blocks(
     block = min(block, max(n_pods, 1))
     tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
     pre = _precompute_jit(tensors)
+    # the pod axis may carry MORE pad rows than one block's worth (shape
+    # bucketing pads before this function): iterate only the tiles with
+    # real rows and clamp the final tile's height to the real pod count
+    n_tiles = min(n_tiles, -(-n_pods // block))
     for i in range(n_tiles):
         start = i * block
         ingress_rows, egress, combined = _block_kernel(
