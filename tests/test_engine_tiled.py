@@ -69,7 +69,7 @@ class TestTiledCounts:
         policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=7)
         engine = TpuPolicyEngine(policy, pods, namespaces)
         ing, egr, comb = full_grids(engine, CASES)
-        counts = engine.evaluate_grid_counts(CASES, block=block)
+        counts = engine.evaluate_grid_counts(CASES, block=block, backend="xla")
         assert counts["ingress"] == int(ing.sum())
         assert counts["egress"] == int(egr.sum())
         assert counts["combined"] == int(comb.sum())
