@@ -168,6 +168,25 @@ class TestCLI:
         assert proc.returncode == 0, proc.stderr
         assert "allow-nothing-to-app-web" in proc.stdout
 
+    def test_analyze_query_target(self):
+        """query-target mode (reference analyze.go:170-207): per-pod
+        matching targets + combined rules against the bundled example
+        pod file."""
+        proc = run_cli(
+            "analyze",
+            "--use-example-policies",
+            "--mode",
+            "query-target",
+            "--target-pod-path",
+            "examples/targets.json",
+        )
+        assert proc.returncode == 0, proc.stderr
+        # one block per pod in examples/targets.json
+        assert proc.stdout.count("Matching targets:") == 4
+        assert proc.stdout.count("Combined rules:") == 4
+        # the pod in ns z carries labels; the header must echo their content
+        assert "'tier': 'web'" in proc.stdout
+
     def test_analyze_query_traffic(self, tmp_path):
         traffic = [
             {
