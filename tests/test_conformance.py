@@ -11,7 +11,10 @@ command:
 
 and the committed artifact at artifacts/conformance-journal.jsonl is the
 journal of exactly such a run (216 entries, all passed).  Set
-CYCLONUS_CONFORMANCE_JOURNAL to refresh it via this test.
+CYCLONUS_CONFORMANCE_JOURNAL to refresh it via this test — to a path
+that does not exist yet: the journal is append-only by design (crash
+resume via `generate --resume`), so pointing this at the committed file
+appends 216 duplicate entries and fails the count assertion.
 """
 
 import json
