@@ -186,6 +186,15 @@ def main():
     # immediately on a side thread so it overlaps the host-side synthetic
     # build + matcher compile + encode, and report the residual join time
     # as backend_init_s instead of letting it pollute warmup_s.
+    #
+    # The poke transfer matters as much as jax.devices(): on the axon
+    # service, device *attach* is lazier than device *enumeration*, and
+    # the first real transfer can stall for tens of seconds if another
+    # client still holds the chip (observed: 59.6s in BENCH_r02, against
+    # a 4.3 MB packed buffer that moves in ~3 ms once attached).  Poking
+    # with 4 bytes here pulls that one-time wait into the overlapped
+    # init thread, where it is attributed to backend_init_s instead of
+    # engine.device_put.
     import threading
 
     def _init_backend():
@@ -193,6 +202,7 @@ def main():
             import jax
 
             jax.devices()
+            jax.device_put(np.zeros(1, np.int32)).block_until_ready()
         except Exception:
             pass
 
@@ -313,6 +323,14 @@ def main():
                         "eval_s": round(t_eval, 4),
                         "allow_rate": round(allow_rate, 4),
                         "parity_spot_checks": n_samples,
+                        # host->device payload: the ENTIRE tensor transfer
+                        # is this one buffer (engine/api.py _pack_tensors);
+                        # at ~1.5 GB/s measured tunnel bandwidth it is
+                        # milliseconds, so any large engine.device_put
+                        # phase above is chip-attach wait, not transfer
+                        "packed_mb": round(engine._packed_buf.nbytes / 1e6, 2)
+                        if engine._packed_buf is not None
+                        else None,
                     },
                 }
             )

@@ -29,12 +29,25 @@ def ensure_persistent_compile_cache() -> None:
     if _cache_configured:
         return
     _cache_configured = True
-    setting = _os.environ.get("CYCLONUS_JAX_CACHE", "")
-    if setting == "0" or _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return
     try:
         import jax
 
+        # Full-traceback locations leak CALLER line numbers into the
+        # Mosaic custom-call payload, where the cache key's
+        # strip-debuginfo pass cannot reach (the payload is an opaque
+        # serialized module): editing ANY file on the pallas call stack
+        # — even a benchmark script — minted a fresh key for an
+        # unchanged kernel and re-paid the 20-40s TPU compile.  Frame-
+        # free locations keep the key a function of the program alone.
+        # Applied for user-configured caches too (it is key hygiene, not
+        # cache placement); CYCLONUS_FULL_LOCATIONS=1 restores the
+        # debug-friendly full frames.
+        if _os.environ.get("CYCLONUS_FULL_LOCATIONS", "") != "1":
+            jax.config.update("jax_include_full_tracebacks_in_locations", False)
+
+        setting = _os.environ.get("CYCLONUS_JAX_CACHE", "")
+        if setting == "0" or _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            return
         if jax.config.jax_compilation_cache_dir:
             return  # the user configured their own cache; leave it alone
         path = setting or _os.path.join(

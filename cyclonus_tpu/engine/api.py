@@ -198,7 +198,15 @@ def _selector_pod_matches_host(tensors: Dict, chunk: int = 0) -> np.ndarray:
     n = tensors["pod_kv"].shape[0]
     s = tensors["sel_req_kv"].shape[0]
     if not chunk:
-        chunk = max(256, (1 << 24) // max(s, 1))
+        # budget the [S, chunk, R, L] and [S, chunk, E, V, L] broadcast
+        # intermediates of _selector_match_np, not just S * chunk: a
+        # label-heavy cluster (large R/E/V/L) scales the temporaries by
+        # the trailing dims too
+        r = tensors["sel_req_kv"].shape[1]
+        e, v = tensors["sel_exp_vals"].shape[1:3]
+        l = tensors["pod_kv"].shape[1]
+        per_pod = max(s, 1) * max(r * l, e * v * l, 1)
+        chunk = max(64, (1 << 24) // per_pod)
     outs = []
     for lo in range(0, n, chunk):
         outs.append(
@@ -473,11 +481,34 @@ def _compaction_enabled(tensors: Dict) -> bool:
     can't stall encode."""
     import os
 
-    if os.environ.get("CYCLONUS_COMPACT", "1") == "0":
+    setting = os.environ.get("CYCLONUS_COMPACT", "")
+    if setting == "0":
         return False
+    if setting == "1":
+        return True  # explicit opt-in overrides the work budget
     s = int(tensors["sel_req_kv"].shape[0])
     n = int(tensors["pod_ns_id"].shape[0])
-    return s * n <= 1 << 31
+    r = int(tensors["sel_req_kv"].shape[1])
+    e, v = (int(x) for x in tensors["sel_exp_vals"].shape[1:3])
+    l = int(tensors["pod_kv"].shape[1])
+    # budget ELEMENT OPS of the host selector pass (S * N * the trailing
+    # broadcast dims of _selector_match_np), not just S * N: 2^32 ops is
+    # ~seconds-to-a-minute of single-threaded numpy.  The old flat S * N
+    # cap bounded memory but let a label-heavy cluster stall encode for
+    # minutes — past this budget the compaction win is dwarfed by its
+    # own cost, so skip it (CYCLONUS_COMPACT=1 forces it back on).
+    ops = s * n * max(r * l, e * v * l, 1)
+    if ops > 1 << 32:
+        import logging
+
+        logging.getLogger(__name__).info(
+            "skipping dead-target compaction: host selector pass would "
+            "cost ~%.1e element ops (budget 2^32); set CYCLONUS_COMPACT=1 "
+            "to force it",
+            float(ops),
+        )
+        return False
+    return True
 
 
 def _pack_tensors(tree):
