@@ -41,9 +41,15 @@ def ensure_persistent_compile_cache() -> None:
         # free locations keep the key a function of the program alone.
         # Applied for user-configured caches too (it is key hygiene, not
         # cache placement); CYCLONUS_FULL_LOCATIONS=1 restores the
-        # debug-friendly full frames.
+        # debug-friendly full frames.  Own try: a jax without this flag
+        # must not knock out the cache configuration below.
         if _os.environ.get("CYCLONUS_FULL_LOCATIONS", "") != "1":
-            jax.config.update("jax_include_full_tracebacks_in_locations", False)
+            try:
+                jax.config.update(
+                    "jax_include_full_tracebacks_in_locations", False
+                )
+            except Exception:
+                pass
 
         setting = _os.environ.get("CYCLONUS_JAX_CACHE", "")
         if setting == "0" or _os.environ.get("JAX_COMPILATION_CACHE_DIR"):

@@ -170,7 +170,11 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
             # columns, so the counts need no masking.  This epilogue runs
             # for every (src, dst) tile pair — at multi-million-pod scale
             # its per-cell VPU work, not the MXU matmuls, is the kernel
-            # floor, so every fused op here was measured to matter.
+            # floor, so every fused op here was measured to matter.  (A
+            # variant that rode the count reductions on the MXU as thin
+            # ones-vector f32 contractions measured ~10% SLOWER at the
+            # 100k bench — thin f32 matmuls underutilize the systolic
+            # array more than the VPU tree-reduce costs.)
             egress = acc_e_ref[:] > 0.0
             ingress = acc_i_ref[:] > 0.0
             combined = egress & ingress
@@ -190,6 +194,68 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
                 counts_ref[:, pl.ds(i, 1), :] = cnt_ref[:].reshape(1, 1, 128)
 
     return _verdict_counts_kernel
+
+
+def _make_verdict_counts_kernel_1chunk():
+    """Kernel body for the SINGLE T-chunk case (n_k_e == n_k_i == 1),
+    which is the common regime after dead-target compaction: both
+    directions' live targets fit one lane-aligned chunk (<= 1024), so
+    there is nothing to accumulate across k.  The general kernel pays,
+    per grid step: two scratch zero-inits, two matmul accumulations into
+    VMEM scratch, and an epilogue that re-reads both scratch tiles —
+    ~8 MB of VMEM round-trips per step that this body skips entirely by
+    keeping the matmul results in registers straight into the count
+    epilogue.  The nz/redir skip machinery is also dropped: the
+    pseudo-target row lives in the (only) chunk, so no block is ever
+    all-zero."""
+
+    def _verdict_counts_kernel_1chunk(
+        a_e_ref,  # [BS, KT] bf16   tmatch_e^T src block
+        b_e_ref,  # [1, KT, BD] bf16  tallow_e (q, dst block j)
+        b_i_ref,  # [1, KT, BS] bf16  tallow_i (q, src block i)
+        a_i_ref,  # [KT, BD] bf16   tmatch_i (dst block j)
+        counts_ref,  # [1, n_i, 128] int32 per-q count plane
+        cnt_ref,  # [1, 128] int32 scratch: running counts for this (q, i)
+    ):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        n_j = pl.num_programs(2)
+
+        @pl.when((i == 0) & (j == 0))
+        def _init_counts():
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+
+        @pl.when(j == 0)
+        def _init_cnt():
+            cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+        acc_e = jnp.dot(
+            a_e_ref[:], b_e_ref[0], preferred_element_type=jnp.float32
+        )
+        acc_i = jax.lax.dot_general(
+            b_i_ref[0],
+            a_i_ref[:],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        egress = acc_e > 0.0
+        ingress = acc_i > 0.0
+        combined = egress & ingress
+        c_in = jnp.sum(ingress.astype(jnp.int32))
+        c_eg = jnp.sum(egress.astype(jnp.int32))
+        c_co = jnp.sum(combined.astype(jnp.int32))
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        cnt_ref[:] += (
+            jnp.where(lane == 0, c_in, 0)
+            + jnp.where(lane == 1, c_eg, 0)
+            + jnp.where(lane == 2, c_co, 0)
+        )
+
+        @pl.when(j == n_j - 1)
+        def _flush():
+            counts_ref[:, pl.ds(i, 1), :] = cnt_ref[:].reshape(1, 1, 128)
+
+    return _verdict_counts_kernel_1chunk
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
@@ -288,6 +354,29 @@ def verdict_counts_pallas(
             f"pod axis {n_pad} too large for int32 tile counts at bs={bs}"
         )
     n_j = n_pad // bd
+    if n_k_e == 1 and n_k_i == 1:
+        # single-T-chunk fast path: no cross-k accumulation, so skip the
+        # scratch accumulators and the nz/redir skip machinery entirely
+        counts = pl.pallas_call(
+            _make_verdict_counts_kernel_1chunk(),
+            grid=(q, n_i, n_j),
+            in_specs=[
+                pl.BlockSpec((bs, kt_e), lambda q, i, j: (i, 0)),
+                pl.BlockSpec((1, kt_e, bd), lambda q, i, j: (q, 0, j)),
+                pl.BlockSpec((1, kt_i, bs), lambda q, i, j: (q, 0, i)),
+                pl.BlockSpec((kt_i, bd), lambda q, i, j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, n_i, 128), lambda q, i, j: (q, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((1, 128), jnp.int32)],
+            out_shape=jax.ShapeDtypeStruct((q, n_i, 128), jnp.int32),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * q * n_pad * n_pad * (kt_e + kt_i),
+                bytes_accessed=2 * q * n_i * n_pad * (kt_e + kt_i),
+                transcendentals=0,
+            ),
+            interpret=interpret,
+        )(a_e, b_e, b_i, a_i)
+        return counts[:, :, :3]
     grid = (q, n_i, n_j, max(n_k_e, n_k_i))
     # content maps for the scalar-prefetch skip: which (pod-tile, T-chunk)
     # tmatch blocks hold any nonzero.  O(N*T) device reduction — noise

@@ -295,6 +295,16 @@ class MockKubernetes(IKubernetes):
     def get_pods_in_namespace(self, namespace: str) -> List[KubePod]:
         return list(self._ns(namespace).pods.values())
 
+    # cluster-wide reads (on the concrete backends, not IKubernetes,
+    # mirroring the reference where GetAllNamespaces lives on
+    # kube.Kubernetes rather than the interface — kubernetes.go)
+
+    def get_all_namespaces(self) -> List[KubeNamespace]:
+        return [m.namespace_object for m in self.namespaces.values()]
+
+    def get_pods_all_namespaces(self) -> List[KubePod]:
+        return [p for m in self.namespaces.values() for p in m.pods.values()]
+
     # exec
 
     def execute_remote_command(

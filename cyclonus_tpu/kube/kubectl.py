@@ -206,6 +206,23 @@ class KubectlKubernetes(IKubernetes):
         d = self._get_json(["get", "pods", "-n", namespace])
         return [_pod_from_json(item) for item in d.get("items", [])]
 
+    # cluster-wide reads (concrete-backend methods like the reference's
+    # kube.Kubernetes.GetAllNamespaces, kubernetes.go)
+
+    def get_all_namespaces(self) -> List[KubeNamespace]:
+        d = self._get_json(["get", "namespaces"])
+        return [
+            KubeNamespace(
+                name=item["metadata"]["name"],
+                labels=item["metadata"].get("labels") or {},
+            )
+            for item in d.get("items", [])
+        ]
+
+    def get_pods_all_namespaces(self) -> List[KubePod]:
+        d = self._get_json(["get", "pods", "--all-namespaces"])
+        return [_pod_from_json(item) for item in d.get("items", [])]
+
     # exec
 
     def execute_remote_command(

@@ -1,0 +1,85 @@
+"""A recording fake kubectl for PATH-shim tests (shared by
+test_kubectl.py and the kube-sourced CLI tests).
+
+Each invocation appends {argv, stdin} to calls.jsonl and pops the next
+canned {rc, stdout, stderr} response from a queue of resp_NNNN.json
+files — tests enqueue responses in call order and assert the recorded
+argv afterward."""
+
+import json
+import stat
+import sys
+from pathlib import Path
+
+FAKE_KUBECTL = """#!{python}
+import json, os, sys
+root = {root!r}
+calls = os.path.join(root, "calls.jsonl")
+with open(calls, "a") as f:
+    f.write(json.dumps({{"argv": sys.argv[1:], "stdin": sys.stdin.read()
+                        if not sys.stdin.isatty() else ""}}) + "\\n")
+queue = sorted(p for p in os.listdir(root) if p.startswith("resp_"))
+if not queue:
+    sys.stderr.write("fake kubectl: no canned response left")
+    sys.exit(9)
+path = os.path.join(root, queue[0])
+with open(path) as f:
+    resp = json.load(f)
+os.unlink(path)
+sys.stdout.write(resp.get("stdout", ""))
+sys.stderr.write(resp.get("stderr", ""))
+sys.exit(resp.get("rc", 0))
+"""
+
+
+class FakeKubectl:
+    """Manages the PATH shim: enqueue responses, read back recorded calls."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self._n = 0
+        shim = root / "kubectl"
+        shim.write_text(
+            FAKE_KUBECTL.format(python=sys.executable, root=str(root))
+        )
+        shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+
+    def enqueue(self, stdout="", rc=0, stderr=""):
+        if not isinstance(stdout, str):
+            stdout = json.dumps(stdout)
+        (self.root / f"resp_{self._n:04d}.json").write_text(
+            json.dumps({"stdout": stdout, "rc": rc, "stderr": stderr})
+        )
+        self._n += 1
+
+    def calls(self):
+        path = self.root / "calls.jsonl"
+        if not path.exists():
+            return []
+        return [
+            json.loads(line)
+            for line in path.read_text().splitlines()
+            if line.strip()
+        ]
+
+    def last(self):
+        return self.calls()[-1]
+
+
+def pod_json(ns="x", name="a", labels=None, phase="Running", ip="10.0.0.9"):
+    """A minimal kubectl-shaped pod object with one agnhost-like container."""
+    return {
+        "metadata": {"namespace": ns, "name": name, "labels": labels or {"pod": name}},
+        "spec": {
+            "containers": [
+                {
+                    "name": "cont-80-tcp",
+                    "image": "img",
+                    "ports": [
+                        {"containerPort": 80, "name": "serve-80-tcp", "protocol": "TCP"}
+                    ],
+                }
+            ]
+        },
+        "status": {"phase": phase, "podIP": ip},
+    }

@@ -1,0 +1,121 @@
+"""analyze with live-cluster pod sourcing (fake kubectl on PATH).
+
+Covers the reference behaviors rebuilt in cli/analyze.py:
+  * query-target with pods sourced from the cluster and merged with the
+    JSON file (analyze.go:133-140, 170-178)
+  * probe mode building probe.Resources from cluster pods/namespaces and
+    running an all-available probe without a model file
+    (analyze.go:255-299), including the skip-warnings for port-less
+    containers / container-less pods
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fakekubectl import FakeKubectl, pod_json
+
+DENY_ALL_X = {
+    "apiVersion": "networking.k8s.io/v1",
+    "kind": "NetworkPolicy",
+    "metadata": {"name": "deny-all", "namespace": "x"},
+    "spec": {"podSelector": {}, "policyTypes": ["Ingress"]},
+}
+
+
+def run_cli(fake_root, *args, timeout=300):
+    env = dict(os.environ)
+    env["PATH"] = f"{fake_root}{os.pathsep}{env.get('PATH', '')}"
+    return subprocess.run(
+        [sys.executable, "-m", "cyclonus_tpu"] + list(args),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd="/root/repo",
+        env=env,
+    )
+
+
+@pytest.fixture
+def fake(tmp_path):
+    return FakeKubectl(tmp_path)
+
+
+def test_query_target_sources_pods_from_cluster(fake):
+    # call order for -n x: policies, pods, namespace labels
+    fake.enqueue({"items": [DENY_ALL_X]})
+    fake.enqueue({"items": [pod_json(ns="x", name="a", labels={"pod": "a"})]})
+    fake.enqueue({"metadata": {"name": "x", "labels": {"ns": "x"}}})
+    proc = run_cli(fake.root, "analyze", "-n", "x", "--mode", "query-target")
+    assert proc.returncode == 0, proc.stderr
+    assert "pod in ns x with labels {'pod': 'a'}" in proc.stdout
+    assert "x/deny-all" in proc.stdout  # the target matching the pod
+
+
+def test_query_target_merges_cluster_and_file(fake, tmp_path):
+    fake.enqueue({"items": [DENY_ALL_X]})
+    fake.enqueue({"items": [pod_json(ns="x", name="a", labels={"pod": "a"})]})
+    fake.enqueue({"metadata": {"name": "x", "labels": {"ns": "x"}}})
+    pod_file = tmp_path / "pods.json"
+    pod_file.write_text(
+        json.dumps([{"Namespace": "other", "Labels": {"pod": "z"}}])
+    )
+    proc = run_cli(
+        fake.root,
+        "analyze", "-n", "x", "--mode", "query-target",
+        "--target-pod-path", str(pod_file),
+    )
+    assert proc.returncode == 0, proc.stderr
+    # cluster pod first, file pod appended (analyze.go:171-178)
+    out = proc.stdout
+    assert out.index("pod in ns x") < out.index("pod in ns other")
+
+
+def test_probe_builds_resources_from_cluster(fake):
+    pods = [
+        pod_json(ns="x", name="a", labels={"pod": "a"}, ip="10.0.0.1"),
+        pod_json(ns="x", name="b", labels={"pod": "b"}, ip="10.0.0.2"),
+    ]
+    # a pod whose only container has no ports -> skipped with a warning
+    portless = pod_json(ns="x", name="c", ip="10.0.0.3")
+    portless["spec"]["containers"][0]["ports"] = []
+    fake.enqueue({"items": [DENY_ALL_X]})
+    fake.enqueue({"items": pods + [portless]})
+    fake.enqueue({"metadata": {"name": "x", "labels": {"ns": "x"}}})
+    proc = run_cli(
+        fake.root, "analyze", "-n", "x", "--mode", "probe", "--engine", "oracle"
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Combined:" in proc.stdout
+    # deny-all in x: the 2x2 combined table is all X
+    assert "x/a" in proc.stdout and "x/b" in proc.stdout
+    combined = proc.stdout.split("Combined:")[1]
+    assert "| X   | X   |" in combined
+    assert "skipping container x/c/cont-80-tcp, no ports available" in proc.stderr
+    assert "skipping pod x/c, no containers available" in proc.stderr
+    assert "x/c" not in proc.stdout
+
+
+def test_probe_without_model_or_cluster_fails(fake):
+    proc = run_cli(fake.root, "analyze", "--mode", "probe")
+    assert proc.returncode != 0
+    assert "probe mode needs a model" in (proc.stderr + proc.stdout)
+
+
+def test_all_namespaces_sources_everything(fake):
+    fake.enqueue({"items": [DENY_ALL_X]})  # netpols -A
+    fake.enqueue({"items": [pod_json(ns="x", name="a")]})  # pods -A
+    fake.enqueue(
+        {"items": [{"metadata": {"name": "x", "labels": {"ns": "x"}}}]}
+    )  # namespaces
+    proc = run_cli(fake.root, "analyze", "-A", "--mode", "query-target")
+    assert proc.returncode == 0, proc.stderr
+    argvs = [c["argv"] for c in fake.calls()]
+    assert argvs == [
+        ["get", "networkpolicy", "--all-namespaces", "-o", "json"],
+        ["get", "pods", "--all-namespaces", "-o", "json"],
+        ["get", "namespaces", "-o", "json"],
+    ]

@@ -1,0 +1,364 @@
+"""KubectlKubernetes against a fake kubectl on PATH.
+
+The real-cluster backend (kube/kubectl.py, rebuilding the reference's
+client-go layer at pkg/kube/kubernetes.go:24-218) shells out to kubectl
+for every operation.  These tests put a recording fake kubectl first on
+PATH: each invocation appends {argv, stdin} to a call log and pops the
+next canned {rc, stdout, stderr} response from a queue — so every public
+method is asserted against the exact argv it constructs and the exact
+JSON it parses, with no cluster anywhere."""
+
+import json
+import os
+
+import pytest
+
+from cyclonus_tpu.kube.ikubernetes import KubeError
+from cyclonus_tpu.kube.kubectl import KubectlKubernetes
+from cyclonus_tpu.kube.netpol import (
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.kube.objects import (
+    KubeContainer,
+    KubeContainerPort,
+    KubeNamespace,
+    KubePod,
+    KubeService,
+    KubeServicePort,
+)
+
+from fakekubectl import FakeKubectl, pod_json
+
+
+@pytest.fixture
+def fake(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "PATH", f"{tmp_path}{os.pathsep}{os.environ.get('PATH', '')}"
+    )
+    return FakeKubectl(tmp_path)
+
+
+@pytest.fixture
+def kube(fake):
+    return KubectlKubernetes()
+
+
+def test_missing_kubectl_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+    with pytest.raises(KubeError, match="kubectl not found"):
+        KubectlKubernetes()
+
+
+def test_context_flag_prefixes_every_command(fake):
+    k = KubectlKubernetes(context="kind-calico")
+    fake.enqueue({"metadata": {"name": "x", "labels": {"ns": "x"}}})
+    k.get_namespace("x")
+    assert fake.last()["argv"][:2] == ["--context", "kind-calico"]
+
+
+def test_error_maps_to_kube_error(fake, kube):
+    fake.enqueue(rc=1, stderr='namespaces "zzz" not found')
+    with pytest.raises(KubeError, match='namespaces "zzz" not found'):
+        kube.get_namespace("zzz")
+
+
+# ---------------------------------------------------------------- namespaces
+
+
+def test_create_namespace(fake, kube):
+    fake.enqueue("namespace/x created")
+    ns = kube.create_namespace(KubeNamespace(name="x", labels={"ns": "x"}))
+    assert ns.name == "x"
+    call = fake.last()
+    assert call["argv"] == ["apply", "-f", "-"]
+    manifest = json.loads(call["stdin"])
+    assert manifest == {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": "x", "labels": {"ns": "x"}},
+    }
+
+
+def test_get_namespace(fake, kube):
+    fake.enqueue({"metadata": {"name": "y", "labels": {"ns": "y", "team": "a"}}})
+    ns = kube.get_namespace("y")
+    assert fake.last()["argv"] == ["get", "namespace", "y", "-o", "json"]
+    assert (ns.name, ns.labels) == ("y", {"ns": "y", "team": "a"})
+
+
+def test_get_namespace_null_labels(fake, kube):
+    fake.enqueue({"metadata": {"name": "y", "labels": None}})
+    assert kube.get_namespace("y").labels == {}
+
+
+def test_set_namespace_labels_clears_old_keys(fake, kube):
+    # reference semantics (kubernetes.go SetNamespaceLabels): REPLACE the
+    # label set — the merge patch must null out keys absent from the new set
+    fake.enqueue({"metadata": {"name": "y", "labels": {"old": "1", "ns": "y"}}})
+    fake.enqueue("namespace/y patched")
+    ns = kube.set_namespace_labels("y", {"ns": "y", "new": "2"})
+    assert ns.labels == {"ns": "y", "new": "2"}
+    call = fake.last()
+    assert call["argv"][:4] == ["patch", "namespace", "y", "--type=merge"]
+    patch = json.loads(call["argv"][5])
+    assert patch == {"metadata": {"labels": {"old": None, "ns": "y", "new": "2"}}}
+
+
+def test_delete_namespace(fake, kube):
+    fake.enqueue("namespace/x deleted")
+    kube.delete_namespace("x")
+    assert fake.last()["argv"] == ["delete", "namespace", "x", "--wait=true"]
+
+
+# ------------------------------------------------------------- netpols
+
+
+def netpol(ns="x", name="np1"):
+    return NetworkPolicy(
+        name=name,
+        namespace=ns,
+        spec=NetworkPolicySpec(
+            pod_selector=LabelSelector.make(match_labels={"pod": "a"}),
+            policy_types=["Ingress"],
+        ),
+    )
+
+
+def test_create_network_policy_round_trips_yaml_dict(fake, kube):
+    fake.enqueue("networkpolicy/np1 created")
+    kube.create_network_policy(netpol())
+    call = fake.last()
+    assert call["argv"] == ["apply", "-f", "-"]
+    manifest = json.loads(call["stdin"])
+    assert manifest["kind"] == "NetworkPolicy"
+    assert manifest["metadata"]["name"] == "np1"
+    assert manifest["spec"]["podSelector"] == {"matchLabels": {"pod": "a"}}
+
+
+def test_get_network_policies_in_namespace(fake, kube):
+    from cyclonus_tpu.kube.yaml_io import policy_to_dict
+
+    fake.enqueue({"items": [policy_to_dict(netpol()), policy_to_dict(netpol(name="np2"))]})
+    pols = kube.get_network_policies_in_namespace("x")
+    assert fake.last()["argv"] == ["get", "networkpolicy", "-n", "x", "-o", "json"]
+    assert [p.name for p in pols] == ["np1", "np2"]
+    assert pols[0].namespace == "x"
+
+
+def test_get_network_policies_all_namespaces(fake, kube):
+    fake.enqueue({"items": []})
+    assert kube.get_network_policies_all_namespaces() == []
+    assert fake.last()["argv"] == [
+        "get", "networkpolicy", "--all-namespaces", "-o", "json",
+    ]
+
+
+def test_update_network_policy_applies(fake, kube):
+    fake.enqueue("networkpolicy/np1 configured")
+    kube.update_network_policy(netpol())
+    assert fake.last()["argv"] == ["apply", "-f", "-"]
+
+
+def test_delete_network_policy(fake, kube):
+    fake.enqueue("deleted")
+    kube.delete_network_policy("x", "np1")
+    assert fake.last()["argv"] == ["delete", "networkpolicy", "np1", "-n", "x"]
+
+
+def test_delete_all_network_policies_in_namespace(fake, kube):
+    fake.enqueue("deleted")
+    kube.delete_all_network_policies_in_namespace("x")
+    assert fake.last()["argv"] == ["delete", "networkpolicy", "--all", "-n", "x"]
+
+
+# ------------------------------------------------------------- services
+
+
+def test_create_service(fake, kube):
+    fake.enqueue("service/s created")
+    svc = KubeService(
+        namespace="x",
+        name="s-x-a",
+        selector={"pod": "a"},
+        ports=[KubeServicePort(port=80, name="service-port-tcp-80", protocol="TCP")],
+    )
+    kube.create_service(svc)
+    manifest = json.loads(fake.last()["stdin"])
+    assert manifest["metadata"] == {"name": "s-x-a", "namespace": "x"}
+    assert manifest["spec"]["selector"] == {"pod": "a"}
+    assert manifest["spec"]["ports"] == [
+        {"name": "service-port-tcp-80", "port": 80, "protocol": "TCP"}
+    ]
+
+
+def test_get_service(fake, kube):
+    fake.enqueue(
+        {
+            "spec": {
+                "selector": {"pod": "a"},
+                "ports": [{"port": 80, "name": "p", "protocol": "UDP"}],
+                "clusterIP": "10.96.0.12",
+            }
+        }
+    )
+    svc = kube.get_service("x", "s-x-a")
+    assert fake.last()["argv"] == ["get", "service", "s-x-a", "-n", "x", "-o", "json"]
+    assert svc.cluster_ip == "10.96.0.12"
+    assert svc.ports[0].protocol == "UDP"
+
+
+def test_get_services_in_namespace_fetches_each(fake, kube):
+    fake.enqueue({"items": [{"metadata": {"name": "s1"}}]})
+    fake.enqueue({"spec": {"selector": {}, "ports": [], "clusterIP": "ip"}})
+    svcs = kube.get_services_in_namespace("x")
+    assert [s.name for s in svcs] == ["s1"]
+    argvs = [c["argv"] for c in fake.calls()]
+    assert argvs == [
+        ["get", "service", "-n", "x", "-o", "json"],
+        ["get", "service", "s1", "-n", "x", "-o", "json"],
+    ]
+
+
+def test_delete_service(fake, kube):
+    fake.enqueue("deleted")
+    kube.delete_service("x", "s")
+    assert fake.last()["argv"] == ["delete", "service", "s", "-n", "x"]
+
+
+# ------------------------------------------------------------------ pods
+
+
+def test_create_pod_tcp_container_manifest(fake, kube):
+    fake.enqueue("pod/a created")
+    pod = KubePod(
+        namespace="x",
+        name="a",
+        labels={"pod": "a"},
+        containers=[
+            KubeContainer(
+                name="cont-80-tcp",
+                ports=[KubeContainerPort(container_port=80, name="serve-80-tcp")],
+            )
+        ],
+    )
+    kube.create_pod(pod)
+    manifest = json.loads(fake.last()["stdin"])
+    assert manifest["spec"]["terminationGracePeriodSeconds"] == 0
+    c = manifest["spec"]["containers"][0]
+    # agnhost serve-hostname pinned to the port, like the reference's
+    # KubePod containers (pod.go)
+    assert c["command"] == [
+        "/agnhost", "serve-hostname", "--tcp", "--http=false", "--port", "80",
+    ]
+    assert c["ports"] == [
+        {"containerPort": 80, "name": "serve-80-tcp", "protocol": "TCP"}
+    ]
+
+
+def test_create_pod_sctp_uses_porter(fake, kube):
+    fake.enqueue("pod/a created")
+    pod = KubePod(
+        namespace="x",
+        name="a",
+        containers=[
+            KubeContainer(
+                name="c",
+                ports=[
+                    KubeContainerPort(
+                        container_port=82, name="serve-82-sctp", protocol="SCTP"
+                    )
+                ],
+            )
+        ],
+    )
+    kube.create_pod(pod)
+    c = json.loads(fake.last()["stdin"])["spec"]["containers"][0]
+    assert c["command"] == ["/agnhost", "porter"]
+    assert c["env"] == [{"name": "SERVE_SCTP_PORT_82", "value": "foo"}]
+
+
+def test_get_pod_parses_status(fake, kube):
+    fake.enqueue(pod_json())
+    pod = kube.get_pod("x", "a")
+    assert fake.last()["argv"] == ["get", "pod", "a", "-n", "x", "-o", "json"]
+    assert (pod.phase, pod.pod_ip) == ("Running", "10.0.0.9")
+    assert pod.containers[0].ports[0].container_port == 80
+
+
+def test_delete_pod_does_not_wait(fake, kube):
+    fake.enqueue("deleted")
+    kube.delete_pod("x", "a")
+    assert fake.last()["argv"] == ["delete", "pod", "a", "-n", "x", "--wait=false"]
+
+
+def test_set_pod_labels_clears_old_keys(fake, kube):
+    fake.enqueue(pod_json(labels={"pod": "a", "stale": "1"}))
+    fake.enqueue("pod/a patched")
+    pod = kube.set_pod_labels("x", "a", {"pod": "a"})
+    assert pod.labels == {"pod": "a"}
+    call = fake.last()
+    assert call["argv"][:5] == ["patch", "pod", "a", "-n", "x"]
+    assert call["argv"][5] == "--type=merge"
+    patch = json.loads(call["argv"][7])
+    assert patch == {"metadata": {"labels": {"pod": "a", "stale": None}}}
+
+
+def test_get_pods_in_namespace(fake, kube):
+    fake.enqueue({"items": [pod_json(), pod_json(name="b", ip="10.0.0.10")]})
+    pods = kube.get_pods_in_namespace("x")
+    assert fake.last()["argv"] == ["get", "pods", "-n", "x", "-o", "json"]
+    assert [p.name for p in pods] == ["a", "b"]
+    assert pods[1].pod_ip == "10.0.0.10"
+
+
+def test_get_all_namespaces(fake, kube):
+    fake.enqueue(
+        {
+            "items": [
+                {"metadata": {"name": "x", "labels": {"ns": "x"}}},
+                {"metadata": {"name": "y", "labels": None}},
+            ]
+        }
+    )
+    nss = kube.get_all_namespaces()
+    assert fake.last()["argv"] == ["get", "namespaces", "-o", "json"]
+    assert [(n.name, n.labels) for n in nss] == [("x", {"ns": "x"}), ("y", {})]
+
+
+def test_get_pods_all_namespaces(fake, kube):
+    fake.enqueue({"items": [pod_json(ns="x"), pod_json(ns="y", name="b")]})
+    pods = kube.get_pods_all_namespaces()
+    assert fake.last()["argv"] == ["get", "pods", "--all-namespaces", "-o", "json"]
+    assert [(p.namespace, p.name) for p in pods] == [("x", "a"), ("y", "b")]
+
+
+# ------------------------------------------------------------------ exec
+
+
+def test_execute_remote_command_success(fake, kube):
+    fake.enqueue(stdout="hi\n", stderr="")
+    out, err, failure = kube.execute_remote_command(
+        "x", "a", "cont-80-tcp", ["/agnhost", "connect", "s-x-b.x.svc:80"]
+    )
+    assert (out, err, failure) == ("hi\n", "", None)
+    assert fake.last()["argv"] == [
+        "exec", "a", "-c", "cont-80-tcp", "-n", "x", "--",
+        "/agnhost", "connect", "s-x-b.x.svc:80",
+    ]
+
+
+def test_execute_remote_command_failure_returns_not_raises(fake, kube):
+    # probe failures are DATA (the X cells of the truth table), not errors:
+    # reference executeRemoteCommand returns (out, err, error) without
+    # failing the run (kubernetes.go:182-218)
+    fake.enqueue(stdout="", stderr="TIMEOUT", rc=1)
+    out, err, failure = kube.execute_remote_command("x", "a", "c", ["cmd"])
+    assert (out, err, failure) == ("", "TIMEOUT", "TIMEOUT")
+
+
+def test_execute_remote_command_failure_empty_stderr(fake, kube):
+    fake.enqueue(rc=7)
+    out, err, failure = kube.execute_remote_command("x", "a", "c", ["cmd"])
+    assert failure == "command failed"
