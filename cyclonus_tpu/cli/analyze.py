@@ -79,15 +79,16 @@ def _bool_action():
     return _B
 
 
-def _read_cluster(args, want_pods: bool):
+def _read_cluster(args, want_pods: bool, want_ns_labels: bool):
     """Kube-sourced inputs (RunAnalyzeCommand step 1, analyze.go:91-109):
-    policies — plus pods and namespace labels when a requested mode
-    consumes them (query-target/probe; fetching the whole pod list for
-    lint/explain would stall large clusters for nothing) — from the live
-    cluster whenever -n/-A is given.  One deviation, noted: with -n the
-    reference leaves the namespace-label map empty (only -A fills it,
-    analyze.go:100-105), which silently breaks namespace selectors in
-    probe mode — here the named namespaces' labels are fetched too."""
+    policies — plus pods (query-target/probe) and namespace labels
+    (probe only) when a requested mode consumes them; fetching the whole
+    pod list for lint/explain would stall large clusters for nothing —
+    from the live cluster whenever -n/-A is given.  One deviation,
+    noted: with -n the reference leaves the namespace-label map empty
+    (only -A fills it, analyze.go:100-105), which silently breaks
+    namespace selectors in probe mode — here the named namespaces'
+    labels are fetched too."""
     policies: List[NetworkPolicy] = []
     kube_pods = []  # List[KubePod]
     kube_namespaces = {}  # Dict[ns name, labels]
@@ -102,6 +103,7 @@ def _read_cluster(args, want_pods: bool):
             policies.extend(kube.get_network_policies_all_namespaces())
             if want_pods:
                 kube_pods.extend(kube.get_pods_all_namespaces())
+            if want_ns_labels:
                 for ns in kube.get_all_namespaces():
                     kube_namespaces[ns.name] = ns.labels
         else:
@@ -109,6 +111,7 @@ def _read_cluster(args, want_pods: bool):
                 policies.extend(kube.get_network_policies_in_namespace(ns))
                 if want_pods:
                     kube_pods.extend(kube.get_pods_in_namespace(ns))
+                if want_ns_labels:
                     kube_namespaces[ns] = kube.get_namespace(ns).labels
     return policies, kube_pods, kube_namespaces
 
@@ -116,7 +119,10 @@ def _read_cluster(args, want_pods: bool):
 def run_analyze(args) -> int:
     modes = args.mode or ["explain"]
     want_pods = bool({"query-target", "probe"} & set(modes))
-    kube_policies, kube_pods, kube_namespaces = _read_cluster(args, want_pods)
+    want_ns_labels = "probe" in modes  # only probe consumes ns labels
+    kube_policies, kube_pods, kube_namespaces = _read_cluster(
+        args, want_pods, want_ns_labels
+    )
     if args.policy_path:
         kube_policies = kube_policies + load_policies_from_path(args.policy_path)
     if args.use_example_policies:

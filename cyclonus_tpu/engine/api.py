@@ -849,6 +849,11 @@ class TpuPolicyEngine:
             partials = self._counts_packed_jit(
                 buf, self._pod_perm_dev, q_port, q_name, q_proto, np.int32(n)
             )
+        # the [Q, n_tiles, 3] readback is the execution barrier: device
+        # run time (and, on a remote-attached chip, any service-side
+        # stall) lands here, not in the async dispatch above
+        with phase("engine.execute"):
+            partials = np.asarray(partials)
         return sum_partials(partials, len(cases), n)
 
     def evaluate_grid_counts_sharded(

@@ -43,7 +43,10 @@ class KubectlKubernetes(IKubernetes):
     def _run(self, args: List[str], input_text: Optional[str] = None) -> str:
         proc = subprocess.run(
             self._base() + args,
-            input=input_text,
+            # always give kubectl a CLOSED stdin ("" = empty pipe): with
+            # an inherited never-closing fd 0 (CI runners, nohup), any
+            # kubectl invocation that reads stdin would hang to timeout
+            input=input_text if input_text is not None else "",
             capture_output=True,
             text=True,
             timeout=120,
@@ -232,6 +235,7 @@ class KubectlKubernetes(IKubernetes):
             self._base()
             + ["exec", pod, "-c", container, "-n", namespace, "--"]
             + command,
+            input="",  # closed stdin; see _run
             capture_output=True,
             text=True,
             timeout=60,

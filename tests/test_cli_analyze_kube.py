@@ -45,10 +45,10 @@ def fake(tmp_path):
 
 
 def test_query_target_sources_pods_from_cluster(fake):
-    # call order for -n x: policies, pods, namespace labels
+    # call order for -n x: policies, pods (ns labels are only fetched
+    # for probe mode — query-target never consumes them)
     fake.enqueue({"items": [DENY_ALL_X]})
     fake.enqueue({"items": [pod_json(ns="x", name="a", labels={"pod": "a"})]})
-    fake.enqueue({"metadata": {"name": "x", "labels": {"ns": "x"}}})
     proc = run_cli(fake.root, "analyze", "-n", "x", "--mode", "query-target")
     assert proc.returncode == 0, proc.stderr
     assert "pod in ns x with labels {'pod': 'a'}" in proc.stdout
@@ -58,7 +58,6 @@ def test_query_target_sources_pods_from_cluster(fake):
 def test_query_target_merges_cluster_and_file(fake, tmp_path):
     fake.enqueue({"items": [DENY_ALL_X]})
     fake.enqueue({"items": [pod_json(ns="x", name="a", labels={"pod": "a"})]})
-    fake.enqueue({"metadata": {"name": "x", "labels": {"ns": "x"}}})
     pod_file = tmp_path / "pods.json"
     pod_file.write_text(
         json.dumps([{"Namespace": "other", "Labels": {"pod": "z"}}])
@@ -110,8 +109,9 @@ def test_all_namespaces_sources_everything(fake):
     fake.enqueue({"items": [pod_json(ns="x", name="a")]})  # pods -A
     fake.enqueue(
         {"items": [{"metadata": {"name": "x", "labels": {"ns": "x"}}}]}
-    )  # namespaces
-    proc = run_cli(fake.root, "analyze", "-A", "--mode", "query-target")
+    )  # namespaces (probe consumes ns labels)
+    proc = run_cli(fake.root, "analyze", "-A", "--mode", "probe",
+                   "--engine", "oracle")
     assert proc.returncode == 0, proc.stderr
     argvs = [c["argv"] for c in fake.calls()]
     assert argvs == [
@@ -119,3 +119,12 @@ def test_all_namespaces_sources_everything(fake):
         ["get", "pods", "--all-namespaces", "-o", "json"],
         ["get", "namespaces", "-o", "json"],
     ]
+
+
+def test_lint_mode_fetches_no_pods(fake):
+    # cheap modes must not pull the cluster's pod list (only policies)
+    fake.enqueue({"items": [DENY_ALL_X]})
+    proc = run_cli(fake.root, "analyze", "-n", "x", "--mode", "lint")
+    assert proc.returncode == 0, proc.stderr
+    argvs = [c["argv"] for c in fake.calls()]
+    assert argvs == [["get", "networkpolicy", "-n", "x", "-o", "json"]]

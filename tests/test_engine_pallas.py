@@ -39,6 +39,25 @@ class TestPallasCounts:
         b = engine.evaluate_grid_counts(CASES, backend="pallas")
         assert a == b
 
+    def test_bf16_operand_mode(self, monkeypatch):
+        """The CYCLONUS_PALLAS_DTYPE=bf16 fallback (f32 accumulators)
+        must count identically to the default int8 path.  The env var is
+        read at trace time, so clear jit caches around the flip."""
+        import jax
+
+        policy, pods, namespaces = fuzz_problem(13, n_extra_pods=7)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="pallas")
+        monkeypatch.setenv("CYCLONUS_PALLAS_DTYPE", "bf16")
+        jax.clear_caches()
+        try:
+            engine2 = TpuPolicyEngine(policy, pods, namespaces)
+            got = engine2.evaluate_grid_counts(CASES, backend="pallas")
+        finally:
+            monkeypatch.undo()
+            jax.clear_caches()
+        assert got == want
+
     def test_unequal_direction_chunks(self, monkeypatch):
         """Regression: with different target-axis chunk counts per
         direction (n_k_e != n_k_i), the clamped index maps refetch the
