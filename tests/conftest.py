@@ -1,7 +1,13 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so the
 multi-chip sharding paths are exercised without TPU hardware.
 
-Must run before the first `import jax` anywhere in the test session."""
+Must run before the first backend initialization anywhere in the test
+session.  The env var alone is NOT enough on a machine with a
+remote-attached TPU plugin whose environment pins JAX_PLATFORMS (the
+plugin's sitecustomize wins over a later in-process setdefault, so the
+suite silently ran compiled-on-TPU through the tunnel); the config-level
+update below overrides that.  Set CYCLONUS_TEST_TPU=1 to deliberately
+run the suite against the real default backend instead."""
 
 import os
 
@@ -11,3 +17,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if os.environ.get("CYCLONUS_TEST_TPU", "") != "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

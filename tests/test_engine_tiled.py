@@ -111,6 +111,54 @@ class TestTiledCounts:
         assert counts["egress"] == int(egr.sum())
         assert counts["combined"] == int(comb.sum())
 
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_ring_per_device_shard_shapes(self, n_dev, monkeypatch):
+        """The ring path's O(N / n_dev) per-device memory claim, asserted
+        structurally: inside the shard_map'd per-device function, the pod
+        arrays must arrive with exactly n_padded / n_dev rows.  Recorded
+        by intercepting the per-device _precompute during tracing."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        import cyclonus_tpu.engine.tiled as tiled
+
+        policy, pods, namespaces = fuzz_problem(25, n_extra_pods=13)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        cpu = jax.devices("cpu")
+        if len(cpu) < n_dev:
+            pytest.skip(f"needs {n_dev} CPU devices, have {len(cpu)}")
+        mesh = Mesh(np.array(cpu[:n_dev]), ("x",))
+
+        seen = []
+        real_precompute = tiled._precompute
+
+        def recording_precompute(tensors):
+            seen.append(int(tensors["pod_kv"].shape[0]))
+            return real_precompute(tensors)
+
+        monkeypatch.setattr(tiled, "_precompute", recording_precompute)
+        block = 4
+        want = engine.evaluate_grid_counts(CASES, block=block, backend="xla")
+        got = engine.evaluate_grid_counts_ring(CASES, block=block, mesh=mesh)
+        assert got == want
+        # ring path: per-device pod rows = n_padded / n_dev exactly.
+        # The engine's tensors arrive shape-BUCKETED (api._bucket_pods),
+        # so mesh padding starts from the bucketed axis, not n_pods.
+        # (Only the ring call's trace is asserted — the single-device
+        # reference's module-level jit may be cached from earlier tests
+        # and then never calls the recorder.)
+        n_bucketed = engine._tensors["pod_ns_id"].shape[0]
+        # mirror _mesh_counts_setup's block clamp: the engine shrinks the
+        # tile height so every device gets at least one tile
+        n = engine.encoding.cluster.n_pods
+        block_eff = min(block, max(n // n_dev, 1))
+        granule = n_dev * block_eff
+        n_padded = -(-n_bucketed // granule) * granule
+        assert seen, "ring path never traced (unexpected jit cache hit)"
+        assert seen[-1] == n_padded // n_dev
+        assert seen[-1] < n_bucketed  # strictly smaller than the full axis
+
     def test_counts_ring2d_explicit_mesh(self):
         """A caller-provided 4x2 mesh (4 'hosts' x 2 'chips') exercises a
         DCN axis longer than the ICI axis."""
