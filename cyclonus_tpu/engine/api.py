@@ -797,6 +797,20 @@ class TpuPolicyEngine:
             self._tensors_with_cases(cases), n, block=block
         )
 
+    def _pre_bytes_estimate(self, q: int) -> int:
+        """Host-side size estimate of the precompute pytree (dominated by
+        the per-direction [T, N, Q] tallow tensors): deciding the cache
+        cap BEFORE dispatching the split path matters at multi-million-pod
+        scale, where compiling the split programs just to find the result
+        uncacheable cost ~8 minutes on the remote compile service."""
+        n = int(self._tensors["pod_ns_id"].shape[0])
+        t = sum(
+            int(self._tensors[d]["target_ns"].shape[0])
+            for d in ("ingress", "egress")
+        )
+        # tallow bf16 [T, N, Q] per direction + tmatch bool [T, N] + small
+        return t * n * (2 * q + 1)
+
     def _build_counts_jits(self) -> None:
         """Build the three counts programs once per engine: the fused
         cold-path jit (unpack + sort + precompute + pallas in one
@@ -903,6 +917,7 @@ class TpuPolicyEngine:
             self._last_counts_key == key
             and key != self._pre_cache_declined
             and _pre_cache_enabled()
+            and self._pre_bytes_estimate(len(cases)) <= _PRE_CACHE_MAX_BYTES
         ):
             # second consecutive evaluation of the same case set: switch
             # to the split path and keep the precompute device-resident.

@@ -39,6 +39,73 @@ class TestPallasCounts:
         b = engine.evaluate_grid_counts(CASES, backend="pallas")
         assert a == b
 
+    def test_pre_cache_state_machine(self, monkeypatch):
+        """The device-resident precompute cache: populated on the second
+        consecutive evaluation of one case set, hit thereafter, evicted
+        after two consecutive other-set evaluations — with identical
+        counts on every path, and a byte estimate that matches the real
+        pytree."""
+        import cyclonus_tpu.engine.api as api
+
+        policy, pods, namespaces = fuzz_problem(14, n_extra_pods=8)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        A = CASES
+        B = [PortCase(81, "", "UDP")]
+        C = [PortCase(9999, "", "TCP")]
+        want_a = engine.evaluate_grid_counts(A, backend="xla")
+        # 1st A: fused path, no cache; 2nd A: split path populates it
+        assert engine.evaluate_grid_counts(A, backend="pallas") == want_a
+        assert engine._pre_cache is None
+        assert engine.evaluate_grid_counts(A, backend="pallas") == want_a
+        assert engine._pre_cache is not None
+        # estimate matches the cached pytree (has_target [N] x2 is the
+        # only leaf it ignores)
+        import jax
+
+        actual = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(engine._pre_cache[1])
+        )
+        n = engine._tensors["pod_ns_id"].shape[0]
+        assert engine._pre_bytes_estimate(len(A)) == actual - 2 * n
+        # cache hit
+        assert engine.evaluate_grid_counts(A, backend="pallas") == want_a
+        assert engine._pre_cache_misses == 0
+        # one other-set call must NOT evict (A/B alternation)
+        want_b = engine.evaluate_grid_counts(B, backend="xla")
+        assert engine.evaluate_grid_counts(B, backend="pallas") == want_b
+        assert engine._pre_cache is not None
+        assert engine.evaluate_grid_counts(A, backend="pallas") == want_a
+        # B seen again: the split path REPLACES the cached set with B's
+        # (alternating sets each get cached when re-seen, never thrash)
+        assert engine.evaluate_grid_counts(B, backend="pallas") == want_b
+        assert engine._pre_cache is not None
+        assert engine._pre_cache[1]["egress"]["tallow_bf"].shape[-1] == len(B)
+        # two consecutive distinct foreign sets evict outright
+        want_c = engine.evaluate_grid_counts(C, backend="xla")
+        assert engine.evaluate_grid_counts(A, backend="pallas") == want_a
+        assert engine.evaluate_grid_counts(C, backend="pallas") == want_c
+        assert engine._pre_cache is None
+
+    def test_pre_cache_size_gate_and_opt_out(self, monkeypatch):
+        """An over-cap estimate keeps the engine on the fused path (no
+        split compile, no pin); CYCLONUS_PRE_CACHE=0 disables caching."""
+        import cyclonus_tpu.engine.api as api
+
+        policy, pods, namespaces = fuzz_problem(15, n_extra_pods=8)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        monkeypatch.setattr(api, "_PRE_CACHE_MAX_BYTES", 0)
+        for _ in range(3):
+            assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._pre_cache is None
+
+        monkeypatch.undo()
+        monkeypatch.setenv("CYCLONUS_PRE_CACHE", "0")
+        engine2 = TpuPolicyEngine(policy, pods, namespaces)
+        for _ in range(3):
+            assert engine2.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine2._pre_cache is None
+
     def test_bf16_operand_mode(self, monkeypatch):
         """The CYCLONUS_PALLAS_DTYPE=bf16 fallback (f32 accumulators)
         must count identically to the default int8 path.  The env var is
