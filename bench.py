@@ -396,6 +396,10 @@ def main():
                         "warmup_s": round(t_warm, 3),
                         "warmup_phases": warm_phases,
                         "eval_s": round(t_eval, 4),
+                        # per-rep times for transparency: rep 1 runs the
+                        # fused program, rep 2 builds the split/pre-cache
+                        # path, reps 3+ are the cached steady state
+                        "eval_reps": [round(t, 4) for t in times],
                         "allow_rate": round(allow_rate, 4),
                         "parity_spot_checks": n_samples,
                         # host->device payload: the ENTIRE tensor transfer
