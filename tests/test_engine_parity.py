@@ -720,6 +720,60 @@ def run_fuzz_seed(seed, counts=False):
     assert_parity(policy, pods, namespaces, cases, counts=counts)
 
 
+class TestUnparseableIPs:
+    """The engine mirrors the oracle's hard failure on unparseable pod
+    IPs when IPBlock peers are present (kube/ipaddr.py raises; a grid
+    hits every pair) — and must NOT confuse parseable IPv6 with garbage
+    (pod_ip_valid=False covers both; only ipaddress-rejected strings are
+    unparseable)."""
+
+    def _ipblock_policy(self):
+        return mkpol(
+            "ipb",
+            "x",
+            LabelSelector.make(),
+            ["Ingress"],
+            ingress=[
+                NetworkPolicyIngressRule(
+                    from_=[
+                        NetworkPolicyPeer(
+                            ip_block=IPBlock.make("192.168.1.0/24")
+                        )
+                    ]
+                )
+            ],
+        )
+
+    def test_garbage_ip_with_ipblock_raises(self):
+        pods, namespaces = default_cluster()
+        pods[4] = (pods[4][0], pods[4][1], pods[4][2], "not-an-ip")
+        policy = build_network_policies(True, [self._ipblock_policy()])
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        with pytest.raises(ValueError, match="unable to parse"):
+            engine.evaluate_grid(CASES_TCP80)
+        with pytest.raises(ValueError, match="unable to parse"):
+            engine.evaluate_grid_counts(CASES_TCP80)
+
+    def test_ipv6_pod_with_ipblock_is_fine(self):
+        pods, namespaces = default_cluster()
+        pods[4] = (pods[4][0], pods[4][1], pods[4][2], "fd00::1:2")
+        policy = build_network_policies(True, [self._ipblock_policy()])
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        assert_parity(policy, pods, namespaces, CASES_TCP80)
+
+    def test_garbage_ip_without_ipblock_is_tolerated(self):
+        # no IP peers anywhere -> the oracle never parses pod IPs, and
+        # neither does the engine
+        pods, namespaces = default_cluster()
+        pods[4] = (pods[4][0], pods[4][1], pods[4][2], "not-an-ip")
+        policy = build_network_policies(
+            True, [mkpol("deny", "x", LabelSelector.make(), ["Ingress"])]
+        )
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        counts = engine.evaluate_grid_counts(CASES_TCP80)
+        assert counts["cells"] == len(pods) ** 2
+
+
 class TestFuzzParity:
     @pytest.mark.parametrize("seed", range(12))
     def test_fuzz(self, seed):
