@@ -68,7 +68,11 @@ def _kt_for(n_targets: int) -> int:
 
 
 def _tiles_for(
-    kt_e: int, kt_i: int, n: int, single_chunk_int8: bool = False
+    kt_e: int,
+    kt_i: int,
+    n: int,
+    single_chunk_int8: bool = False,
+    n_dst: int = None,
 ) -> Tuple[int, int]:
     """Src/dst tile heights.  From the default (512, 512), double the src
     tile when (a) the T-chunks leave VMEM room for the bigger blocks +
@@ -77,13 +81,17 @@ def _tiles_for(
     (bench-measured 56 -> 68 e9 cells/s at the 100k x 10k config).  On
     the scratch-free single-chunk int8 path the blocks are half the
     bytes and there are no accumulator tiles, so (2048, 1024) fits and
-    measures fastest (0.27 -> 0.19 s at the bench config).  A
-    non-default BS/BD (tests sweep them) is honored as-is."""
+    measures fastest (0.27 -> 0.19 s at the bench config).  The count
+    bound is per (src tile x FULL dst axis), so rectangular callers pass
+    n_dst (defaults to n for the square case).  A non-default BS/BD
+    (tests sweep them) is honored as-is."""
+    if n_dst is None:
+        n_dst = n
     bs, bd = BS, BD
     if (bs, bd) != (512, 512):
         return bs, bd
     if single_chunk_int8:
-        if n > 2 * bs and 2048 * (n + 4096) < 2**31:
+        if n > 2 * bs and 2048 * (n_dst + 4096) < 2**31:
             return 2048, 1024
         # fall through to the doubled-bs check for mid-size clusters
     blocks = 4 * (kt_e + kt_i) * (2 * bs + bd)  # bf16, double-buffered
@@ -91,7 +99,7 @@ def _tiles_for(
     if (
         n > bs  # a single default tile already holds the whole problem
         and blocks + scratch <= 12 * 2**20
-        and 2 * bs * (n + 2048) < 2**31
+        and 2 * bs * (n_dst + 2048) < 2**31
     ):
         bs *= 2
     return bs, bd
@@ -296,10 +304,41 @@ def verdict_counts_pallas(
     n_pods: int | jnp.ndarray = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
+    """Square (src pods == dst pods) form of verdict_counts_pallas_rect:
+    the single-chip counts path.  See the rect docstring for the kernel
+    contract."""
+    n = tmatch_e.shape[1]
+    if n_pods is None:
+        n_pods = n
+    valid = jnp.arange(n) < n_pods  # [N] bool
+    return verdict_counts_pallas_rect(
+        tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+        valid_src=valid, valid_dst=valid, interpret=interpret,
+    )
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def verdict_counts_pallas_rect(
+    tmatch_e: jnp.ndarray,  # [T_e, Ns] bool — egress targets vs SRC pods
+    has_e: jnp.ndarray,  # [Ns] bool — src pod has an egress target
+    tallow_e: jnp.ndarray,  # [T_e, Nd, Q] bf16 (0/1) — egress allows DST
+    tmatch_i: jnp.ndarray,  # [T_i, Nd] bool — ingress targets vs DST pods
+    has_i: jnp.ndarray,  # [Nd] bool — dst pod has an ingress target
+    tallow_i: jnp.ndarray,  # [T_i, Ns, Q] bf16 (0/1) — ingress allows SRC
+    valid_src: jnp.ndarray = None,  # [Ns] bool
+    valid_dst: jnp.ndarray = None,  # [Nd] bool
+    interpret: bool = False,
+) -> jnp.ndarray:
     """[Q, n_src_tiles, 3] int32 partial allow counts (ingress, egress,
-    combined) over the full N x N x Q grid, without materializing any
+    combined) over the Ns x Nd x Q grid, without materializing any
     verdict tensor.  Partials are per (port case, src tile) so each stays
     below 2^31; sum them in int64 on the host.
+
+    RECTANGULAR: the src and dst pod axes are independent, which is what
+    lets the mesh paths run this kernel per device (src = the device's
+    row shard, dst = the full axis or the rotating ring shard).  Validity
+    comes in as per-side masks because a shard's rows are a window of the
+    global pod axis, not a prefix.
 
     The allow-if-no-matching-target rule (reference policy.go:158-160)
     and the pod-validity mask are folded into the contraction as ONE
@@ -322,49 +361,55 @@ def verdict_counts_pallas(
         if os.environ.get("CYCLONUS_PALLAS_DTYPE", "int8") == "bf16"
         else jnp.int8
     )
-    n = tmatch_e.shape[1]
+    ns = tmatch_e.shape[1]
+    nd = tmatch_i.shape[1]
     q = tallow_e.shape[2]
-    if n_pods is None:
-        n_pods = n
-    valid = jnp.arange(n) < n_pods  # [N] bool
-    valid_od = valid.astype(od)
-    valid_q = jnp.broadcast_to(valid_od[None, None, :], (q, 1, n))
+    if valid_src is None:
+        valid_src = jnp.ones(ns, dtype=bool)
+    if valid_dst is None:
+        valid_dst = jnp.ones(nd, dtype=bool)
 
-    def _augment(tmatch, has, tallow_qtn):
-        """Append the pseudo-target row (matches valid no-target pods,
-        allows valid pods) and zero the pad-pod columns of tallow:
-        kind-ALL / 0.0.0.0-0 peers match EVERY pod including the inert
-        pads the pod axis arrives with (shape bucketing pads before the
-        precompute), and an unmasked pad column would count as allowed."""
-        pseudo_match = ((~has) & valid).astype(od)[None, :]
+    def _augment(tmatch, has, tallow_qtn, valid_match, valid_allow):
+        """Append the pseudo-target row (matches valid no-target pods on
+        the MATCH side, allows valid pods on the ALLOW side) and zero the
+        pad-pod columns of tallow: kind-ALL / 0.0.0.0-0 peers match EVERY
+        pod including the inert pads the pod axis arrives with (shape
+        bucketing pads before the precompute), and an unmasked pad column
+        would count as allowed."""
+        va = valid_allow.astype(od)
+        pseudo_match = ((~has) & valid_match).astype(od)[None, :]
         tmatch = jnp.concatenate([tmatch.astype(od), pseudo_match], axis=0)
-        tallow_qtn = tallow_qtn * valid_od[None, None, :]
+        tallow_qtn = tallow_qtn * va[None, None, :]
+        valid_q = jnp.broadcast_to(va[None, None, :], (q, 1, va.shape[0]))
         tallow_qtn = jnp.concatenate([tallow_qtn, valid_q], axis=1)
         return tmatch, tallow_qtn
 
     tm_e, tl_e = _augment(
-        tmatch_e, has_e, jnp.moveaxis(tallow_e, 2, 0).astype(od)
+        tmatch_e, has_e, jnp.moveaxis(tallow_e, 2, 0).astype(od),
+        valid_src, valid_dst,
     )
     tm_i, tl_i = _augment(
-        tmatch_i, has_i, jnp.moveaxis(tallow_i, 2, 0).astype(od)
+        tmatch_i, has_i, jnp.moveaxis(tallow_i, 2, 0).astype(od),
+        valid_dst, valid_src,
     )
     kt_e = _kt_for(tm_e.shape[0])
     kt_i = _kt_for(tm_i.shape[0])
     single_chunk = kt_e >= tm_e.shape[0] and kt_i >= tm_i.shape[0]
     bs, bd = _tiles_for(
-        kt_e, kt_i, n, single_chunk_int8=single_chunk and od == jnp.int8
+        kt_e, kt_i, ns,
+        single_chunk_int8=single_chunk and od == jnp.int8,
+        n_dst=nd,
     )
-    # the pod axis appears as BOTH src tiles (bs) and dst tiles (bd):
-    # pad every pod-axis operand to one common multiple so the two views
-    # agree on n_pad (padding src and dst independently silently dropped
-    # trailing dst rows whenever bs != bd rounded differently)
-    nb = math.lcm(bs, bd)
-    a_e = _pad_to(_pad_to(tm_e, 0, kt_e), 1, nb).T
-    a_i = _pad_to(_pad_to(tm_i, 0, kt_i), 1, nb)
-    b_e = _pad_to(_pad_to(tl_e, 1, kt_e), 2, nb)  # [Q, T_e', N']
-    b_i = _pad_to(_pad_to(tl_i, 1, kt_i), 2, nb)  # [Q, T_i', N']
+    # each axis pads to ITS tile size; the per-axis operand PAIRS pad
+    # identically (a_e + tl_i share the src axis, b_e + a_i the dst
+    # axis), so no view can drop trailing rows of the other
+    a_e = _pad_to(_pad_to(tm_e, 0, kt_e), 1, bs).T  # [Ns', T_e']
+    a_i = _pad_to(_pad_to(tm_i, 0, kt_i), 1, bd)  # [T_i', Nd']
+    b_e = _pad_to(_pad_to(tl_e, 1, kt_e), 2, bd)  # [Q, T_e', Nd']
+    b_i = _pad_to(_pad_to(tl_i, 1, kt_i), 2, bs)  # [Q, T_i', Ns']
 
-    n_pad = a_e.shape[0]
+    ns_pad = a_e.shape[0]
+    nd_pad = a_i.shape[1]
     # the k grid dimension is shared, but each direction only has its OWN
     # padded T-chunk count of real work: the kernel skips the other
     # direction's matmul past its n_k (saving the MXU time), and the
@@ -373,15 +418,15 @@ def verdict_counts_pallas(
     n_k_e = b_e.shape[1] // kt_e
     n_k_i = b_i.shape[1] // kt_i
 
-    n_i = n_pad // bs
-    # per-(q, src-tile) partial counts stay within int32: bs * n_pad
+    n_i = ns_pad // bs
+    # per-(q, src-tile) partial counts stay within int32: bs * nd_pad
     # allowed cells max per block (raise, not assert — this runtime size
     # guard must survive python -O)
-    if bs * n_pad >= 2**31:
+    if bs * nd_pad >= 2**31:
         raise ValueError(
-            f"pod axis {n_pad} too large for int32 tile counts at bs={bs}"
+            f"dst axis {nd_pad} too large for int32 tile counts at bs={bs}"
         )
-    n_j = n_pad // bd
+    n_j = nd_pad // bd
     if n_k_e == 1 and n_k_i == 1:
         # single-T-chunk fast path: no cross-k accumulation, so skip the
         # scratch accumulators and the nz/redir skip machinery entirely
@@ -398,8 +443,8 @@ def verdict_counts_pallas(
             scratch_shapes=[pltpu.VMEM((1, 128), jnp.int32)],
             out_shape=jax.ShapeDtypeStruct((q, n_i, 128), jnp.int32),
             cost_estimate=pl.CostEstimate(
-                flops=2 * q * n_pad * n_pad * (kt_e + kt_i),
-                bytes_accessed=2 * q * n_i * n_pad * (kt_e + kt_i),
+                flops=2 * q * ns_pad * nd_pad * (kt_e + kt_i),
+                bytes_accessed=2 * q * n_i * nd_pad * (kt_e + kt_i),
                 transcendentals=0,
             ),
             interpret=interpret,
@@ -472,11 +517,11 @@ def verdict_counts_pallas(
         # keeps the scheduler conservative rather than starving the
         # pipeline on the dense-tmatch (unsorted/adversarial) case
         cost_estimate=pl.CostEstimate(
-            flops=2 * q * n_pad * n_pad * (n_k_e * kt_e + n_k_i * kt_i),
+            flops=2 * q * ns_pad * nd_pad * (n_k_e * kt_e + n_k_i * kt_i),
             bytes_accessed=2
             * q
-            * (n_pad // bs)
-            * n_pad
+            * n_i
+            * nd_pad
             * (n_k_e * kt_e + n_k_i * kt_i),
             transcendentals=0,
         ),

@@ -544,22 +544,34 @@ def evaluate_grid_counts_ring2d(
 
 
 def evaluate_grid_counts_sharded(
-    tensors: Dict, n_pods: int, block: int = 1024, mesh=None
+    tensors: Dict, n_pods: int, block: int = 1024, mesh=None, kernel: str = None
 ) -> Dict[str, int]:
     """Mesh-parallel tiled counts: the SOURCE-ROW axis is split over the
-    mesh; each device runs the XLA tile loop over its own row shard
-    against the full (replicated) per-direction precompute, and the
-    [n_tiles_local, 3] partials are summed across devices with one psum.
-    Combines the two scale axes: tiling lifts the per-device HBM ceiling,
-    sharding divides wall-clock by the mesh size (tiles are
-    embarrassingly parallel across source rows).
+    mesh; each device evaluates its own row shard against the full
+    (replicated) per-direction precompute, and the per-device partials
+    are combined with one all-gather.  Combines the two scale axes:
+    tiling lifts the per-device HBM ceiling, sharding divides wall-clock
+    by the mesh size (tiles are embarrassingly parallel across source
+    rows).
+
+    kernel="pallas" runs the fused rectangular verdict+count kernel per
+    device (src = the device's row shard, dst = the full axis) — the
+    same program the single-chip fast path uses, so its measured
+    per-device rates carry over; kernel="xla" runs the lax.fori_loop
+    tile loop.  The default picks pallas on TPU, xla elsewhere (where
+    pallas would run in slow interpret mode), mirroring
+    api.evaluate_grid_counts.  Identical counts by construction; the
+    mesh tests pin all of them against the single-device kernel.
 
     The per-pod precompute (selector matches, tallow) is evaluated
-    replicated — it is O(N), negligible next to the O(N^2) tile loop."""
+    replicated — it is O(N), negligible next to the O(N^2) grid."""
+    if kernel is None:
+        kernel = "pallas" if jax.default_backend() == "tpu" else "xla"
     mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
         tensors, n_pods, block, mesh
     )
     tiles_per_dev = n_padded // (n_dev * block)
+    shard = n_padded // n_dev
 
     def per_device(t):
         pre = _precompute(t)
@@ -567,6 +579,29 @@ def evaluate_grid_counts_sharded(
         dev = jax.lax.axis_index("x")
         row0 = dev * tiles_per_dev * block
         valid = jnp.arange(n_padded) < n_pods
+
+        if kernel == "pallas":
+            from .pallas_kernel import (
+                _should_interpret,
+                verdict_counts_pallas_rect,
+            )
+
+            e, ig = pre["egress"], pre["ingress"]
+            sl = partial(jax.lax.dynamic_slice_in_dim, start_index=row0)
+            partials = verdict_counts_pallas_rect(
+                sl(e["tmatch"], slice_size=shard, axis=1),
+                sl(e["has_target"], slice_size=shard, axis=0),
+                e["tallow_bf"],
+                ig["tmatch"],
+                ig["has_target"],
+                sl(ig["tallow_bf"], slice_size=shard, axis=1),
+                valid_src=sl(valid, slice_size=shard, axis=0),
+                valid_dst=valid,
+                interpret=_should_interpret(),
+            )  # [Q, n_src_tiles_local, 3]
+            return jax.lax.all_gather(
+                partials.reshape(-1, 3), "x", axis=0, tiled=True
+            )
 
         def body(i, counts):
             return counts.at[i].set(
