@@ -36,6 +36,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     setup_recipes(sub)
 
     version_cmd = sub.add_parser("version", help="print version information")
+    version_cmd.add_argument(
+        "--devices",
+        action="store_true",
+        help="also enumerate accelerator devices (may initialize a remote "
+        "backend; bounded by --device-timeout)",
+    )
+    version_cmd.add_argument(
+        "--device-timeout",
+        type=float,
+        default=20.0,
+        metavar="SECONDS",
+        help="give up on device enumeration after this many seconds",
+    )
     version_cmd.set_defaults(func=_run_version)
 
     args = parser.parse_args(argv)
@@ -48,12 +61,42 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _run_version(args) -> int:
-    import jax
+    # Static info only, like the reference (pkg/cli/version.go:1-34 prints
+    # build strings): `version` must NEVER initialize an accelerator
+    # backend — on a machine with a remote-attached TPU whose tunnel is
+    # dead, jax.devices() blocks indefinitely (observed: 300s+), and the
+    # one command that must always answer is this one.  jax's version
+    # comes from package metadata, not from importing jax (importing is
+    # safe today, but metadata is safe by construction).
+    from importlib import metadata
 
     print(f"cyclonus-tpu version {__version__}")
-    print(f"jax {jax.__version__}, backend {jax.default_backend()}, "
-          f"{len(jax.devices())} device(s)")
+    try:
+        jax_version = metadata.version("jax")
+    except metadata.PackageNotFoundError:
+        jax_version = "not installed"
+    print(f"jax {jax_version}")
+    if getattr(args, "devices", False):
+        print(_enumerate_devices(args.device_timeout))
     return 0
+
+
+def _enumerate_devices(timeout_s: float) -> str:
+    """Backend device info, bounded: a wedged remote backend costs at
+    most timeout_s, not forever."""
+    from ..utils.bounded import run_bounded
+
+    def probe():
+        import jax
+
+        return f"backend {jax.default_backend()}, {len(jax.devices())} device(s)"
+
+    status, value = run_bounded(probe, timeout_s)
+    if status == "timeout":
+        return f"devices: enumeration timed out after {timeout_s:g}s"
+    if status == "error":
+        return f"devices: enumeration failed ({value!r})"
+    return value
 
 
 if __name__ == "__main__":

@@ -967,11 +967,17 @@ class TpuPolicyEngine:
         return sum_partials(partials, len(cases), n)
 
     def evaluate_grid_counts_sharded(
-        self, cases: Sequence[PortCase], block: int = 1024, mesh=None
+        self,
+        cases: Sequence[PortCase],
+        block: int = 1024,
+        mesh=None,
+        kernel: str = None,
     ) -> Dict[str, int]:
         """Mesh-parallel tiled counts: source rows split over the mesh,
-        per-device tile loop, one all-gather of partials (engine/tiled.py).
-        The multi-chip path for grids past one device's wall-clock."""
+        per-device work, one all-gather of partials (engine/tiled.py).
+        The multi-chip path for grids past one device's wall-clock.
+        kernel="pallas" (the TPU default) runs the fused rectangular
+        verdict+count kernel per device; kernel="xla" the tile loop."""
         self._check_ips()
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
@@ -979,7 +985,8 @@ class TpuPolicyEngine:
         from .tiled import evaluate_grid_counts_sharded
 
         return evaluate_grid_counts_sharded(
-            self._tensors_with_cases(cases), n, block=block, mesh=mesh
+            self._tensors_with_cases(cases), n, block=block, mesh=mesh,
+            kernel=kernel,
         )
 
     def evaluate_grid_counts_ring(

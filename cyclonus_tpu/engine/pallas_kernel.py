@@ -39,6 +39,7 @@ Layout notes:
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Dict, Tuple
 
@@ -91,7 +92,22 @@ def _tiles_for(
     if (bs, bd) != (512, 512):
         return bs, bd
     if single_chunk_int8:
-        if n > 2 * bs and 2048 * (n_dst + 4096) < 2**31:
+        # VMEM gate on the actual chunk sizes, not just the int32 count
+        # bound: the (2048, 1024) tile's double-buffered int8 input
+        # blocks are 2 * (kt_e + kt_i) * (2048 + 1024) bytes, and the
+        # two [2048, 1024] int32 matmul intermediates add ~16 MiB more
+        # against the ~16 MiB/core VMEM budget.  The bench regime
+        # (kt_e + kt_i ~ 640 after compaction) fits with room; with both
+        # directions near the 1024 chunk max (~12 MiB of blocks alone)
+        # Mosaic compilation would fail at runtime — cap the blocks at
+        # 6 MiB (kt_e + kt_i <= 1024) and fall through to the 512-tile
+        # path, whose own budget accounts for kt, when it doesn't fit.
+        blocks_1chunk = 2 * (kt_e + kt_i) * (2048 + 1024)  # int8, dbuf
+        if (
+            n > 2 * bs
+            and 2048 * (n_dst + 4096) < 2**31
+            and blocks_1chunk <= 6 * 2**20
+        ):
             return 2048, 1024
         # fall through to the doubled-bs check for mid-size clusters
     blocks = 4 * (kt_e + kt_i) * (2 * bs + bd)  # bf16, double-buffered
@@ -293,7 +309,27 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
+def _resolve_operand_dtype(operand_dtype: str | None) -> str:
+    """CYCLONUS_PALLAS_DTYPE, resolved OUTSIDE the jitted kernels and
+    passed in as a static argument: the module-level jit caches are
+    keyed on shapes plus statics, so for DIRECT calls to the public
+    wrappers an env flip after a shape has been traced triggers a
+    retrace instead of being silently ignored (previously the env var
+    was read at trace time inside the jit).  Scope: the engine-level
+    programs (api._build_counts_jits, tiled's shard_map bodies) wrap
+    these calls in their own outer jits and therefore still bake the
+    dtype in at THEIR trace time — an engine keeps the operand dtype it
+    was built with, and bench's compiled-parity cases keep their
+    distinct-pod-bucket spacing for exactly that reason."""
+    if operand_dtype is None:
+        operand_dtype = os.environ.get("CYCLONUS_PALLAS_DTYPE", "int8")
+    if operand_dtype not in ("int8", "bf16"):
+        raise ValueError(
+            f"CYCLONUS_PALLAS_DTYPE must be int8 or bf16, got {operand_dtype!r}"
+        )
+    return operand_dtype
+
+
 def verdict_counts_pallas(
     tmatch_e: jnp.ndarray,  # [T_e, N] bool
     has_e: jnp.ndarray,  # [N] bool
@@ -303,22 +339,57 @@ def verdict_counts_pallas(
     tallow_i: jnp.ndarray,  # [T_i, N, Q] bf16 (0/1)
     n_pods: int | jnp.ndarray = None,
     interpret: bool = False,
+    operand_dtype: str = None,
 ) -> jnp.ndarray:
     """Square (src pods == dst pods) form of verdict_counts_pallas_rect:
     the single-chip counts path.  See the rect docstring for the kernel
     contract."""
-    n = tmatch_e.shape[1]
-    if n_pods is None:
-        n_pods = n
-    valid = jnp.arange(n) < n_pods  # [N] bool
-    return verdict_counts_pallas_rect(
+    return _verdict_counts_pallas_square(
         tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
-        valid_src=valid, valid_dst=valid, interpret=interpret,
+        n_pods=n_pods if n_pods is not None else tmatch_e.shape[1],
+        interpret=interpret,
+        operand_dtype=_resolve_operand_dtype(operand_dtype),
     )
 
 
-@partial(jax.jit, static_argnames=("interpret",))
+@partial(jax.jit, static_argnames=("interpret", "operand_dtype"))
+def _verdict_counts_pallas_square(
+    tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+    n_pods, interpret, operand_dtype,
+):
+    n = tmatch_e.shape[1]
+    valid = jnp.arange(n) < n_pods  # [N] bool
+    return _verdict_counts_pallas_rect(
+        tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+        valid_src=valid, valid_dst=valid, interpret=interpret,
+        operand_dtype=operand_dtype,
+    )
+
+
 def verdict_counts_pallas_rect(
+    tmatch_e: jnp.ndarray,
+    has_e: jnp.ndarray,
+    tallow_e: jnp.ndarray,
+    tmatch_i: jnp.ndarray,
+    has_i: jnp.ndarray,
+    tallow_i: jnp.ndarray,
+    valid_src: jnp.ndarray = None,
+    valid_dst: jnp.ndarray = None,
+    interpret: bool = False,
+    operand_dtype: str = None,
+) -> jnp.ndarray:
+    """Public rect entry: resolves the operand dtype eagerly (env or
+    argument) and dispatches to the jitted implementation with it as a
+    static argument.  See _verdict_counts_pallas_rect for the contract."""
+    return _verdict_counts_pallas_rect(
+        tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+        valid_src=valid_src, valid_dst=valid_dst, interpret=interpret,
+        operand_dtype=_resolve_operand_dtype(operand_dtype),
+    )
+
+
+@partial(jax.jit, static_argnames=("interpret", "operand_dtype"))
+def _verdict_counts_pallas_rect(
     tmatch_e: jnp.ndarray,  # [T_e, Ns] bool — egress targets vs SRC pods
     has_e: jnp.ndarray,  # [Ns] bool — src pod has an egress target
     tallow_e: jnp.ndarray,  # [T_e, Nd, Q] bf16 (0/1) — egress allows DST
@@ -328,6 +399,7 @@ def verdict_counts_pallas_rect(
     valid_src: jnp.ndarray = None,  # [Ns] bool
     valid_dst: jnp.ndarray = None,  # [Nd] bool
     interpret: bool = False,
+    operand_dtype: str = "int8",
 ) -> jnp.ndarray:
     """[Q, n_src_tiles, 3] int32 partial allow counts (ingress, egress,
     combined) over the Ns x Nd x Q grid, without materializing any
@@ -353,14 +425,9 @@ def verdict_counts_pallas_rect(
     exact for 0/1 values, double the bf16 MACs/s on v5e, and half the
     HBM/VMEM per block (bench: 0.27 -> 0.19 s at 100k x 10k, verified
     bit-identical vs bf16 and numpy).  CYCLONUS_PALLAS_DTYPE=bf16
-    restores the float path."""
-    import os
-
-    od = (
-        jnp.bfloat16
-        if os.environ.get("CYCLONUS_PALLAS_DTYPE", "int8") == "bf16"
-        else jnp.int8
-    )
+    (resolved by the public wrappers, static here) restores the float
+    path."""
+    od = jnp.bfloat16 if operand_dtype == "bf16" else jnp.int8
     ns = tmatch_e.shape[1]
     nd = tmatch_i.shape[1]
     q = tallow_e.shape[2]
@@ -372,13 +439,20 @@ def verdict_counts_pallas_rect(
     def _augment(tmatch, has, tallow_qtn, valid_match, valid_allow):
         """Append the pseudo-target row (matches valid no-target pods on
         the MATCH side, allows valid pods on the ALLOW side) and zero the
-        pad-pod columns of tallow: kind-ALL / 0.0.0.0-0 peers match EVERY
-        pod including the inert pads the pod axis arrives with (shape
-        bucketing pads before the precompute), and an unmasked pad column
-        would count as allowed."""
+        invalid-pod columns of BOTH operands: kind-ALL / 0.0.0.0-0 peers
+        match EVERY pod including the inert pads the pod axis arrives
+        with (shape bucketing pads before the precompute), and an
+        unmasked pad column would count as allowed.  tmatch needs the
+        mask too — pads match no target, but an arbitrary validity mask
+        (the rect contract) may invalidate a REAL pod that a real target
+        matches, and that pod's rows must come out all-False, not just
+        its columns."""
         va = valid_allow.astype(od)
+        vm = valid_match.astype(od)
         pseudo_match = ((~has) & valid_match).astype(od)[None, :]
-        tmatch = jnp.concatenate([tmatch.astype(od), pseudo_match], axis=0)
+        tmatch = jnp.concatenate(
+            [tmatch.astype(od) * vm[None, :], pseudo_match], axis=0
+        )
         tallow_qtn = tallow_qtn * va[None, None, :]
         valid_q = jnp.broadcast_to(va[None, None, :], (q, 1, va.shape[0]))
         tallow_qtn = jnp.concatenate([tallow_qtn, valid_q], axis=1)

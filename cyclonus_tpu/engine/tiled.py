@@ -567,6 +567,10 @@ def evaluate_grid_counts_sharded(
     replicated — it is O(N), negligible next to the O(N^2) grid."""
     if kernel is None:
         kernel = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if kernel not in ("pallas", "xla"):
+        raise ValueError(
+            f"unknown sharded counts kernel {kernel!r} (want 'pallas' or 'xla')"
+        )
     mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
         tensors, n_pods, block, mesh
     )

@@ -1,0 +1,44 @@
+"""Bounded execution for backend-touching calls.
+
+On a machine with a remote-attached accelerator, jax backend init can
+block indefinitely when the tunnel is dead (round-3 driver artifacts
+measured 300 s+ before being killed).  Every user-facing path that
+merely WANTS the accelerator — rather than being explicitly asked to
+wait for it — runs the touching call through run_bounded and degrades
+gracefully on expiry.  (bench.py's overlapped init thread is the one
+deliberate non-user of this helper: it must START the init early and
+JOIN it later, which a single bounded call cannot express.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+
+def run_bounded(fn: Callable[[], Any], timeout_s: float) -> Tuple[str, Any]:
+    """Run fn() on a daemon thread, waiting at most timeout_s.
+
+    Returns ("ok", result), ("error", exception), or ("timeout", None).
+    On timeout the thread is abandoned (daemon — it cannot be killed and
+    may still complete later, harmlessly); callers must not retry the
+    same blocking call on the main thread, which would just block on the
+    same global init lock.
+    """
+    import threading
+
+    out: dict = {}
+
+    def body():
+        try:
+            out["result"] = fn()
+        except BaseException as e:  # surfaced to the caller, not swallowed
+            out["error"] = e
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return "timeout", None
+    if "error" in out:
+        return "error", out["error"]
+    return "ok", out.get("result")

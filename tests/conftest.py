@@ -12,6 +12,12 @@ run the suite against the real default backend instead."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CLI tests spawn subprocesses that do NOT inherit the in-process CPU pin
+# below; on a machine whose TPU tunnel is dead their bounded backend
+# probe (probe/runner.py accelerator_available) would wait the full 75s
+# default before falling back to the host engine.  Verdicts are engine-
+# independent, so keep the suite fast either way.
+os.environ.setdefault("CYCLONUS_BACKEND_TIMEOUT_S", "15")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

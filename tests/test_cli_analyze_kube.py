@@ -26,7 +26,7 @@ DENY_ALL_X = {
 }
 
 
-def run_cli(fake_root, *args, timeout=300):
+def run_cli(fake_root, *args, timeout=120):
     env = dict(os.environ)
     env["PATH"] = f"{fake_root}{os.pathsep}{env.get('PATH', '')}"
     return subprocess.run(

@@ -136,7 +136,11 @@ class TestLinter:
         assert CHECK_TARGET_ALL_INGRESS_BLOCKED not in {w.check for w in warnings}
 
 
-def run_cli(*args, timeout=300):
+def run_cli(*args, timeout=120):
+    # 120s ceiling: no CLI subprocess here touches an accelerator backend
+    # (version prints static info; analyze/generate/probe --mock run the
+    # oracle engine), so anything past 2 minutes is a hang, and the suite
+    # must fail fast with a diagnosis instead of serializing dead air.
     return subprocess.run(
         [sys.executable, "-m", "cyclonus_tpu"] + list(args),
         capture_output=True,

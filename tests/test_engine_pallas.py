@@ -238,6 +238,140 @@ class TestPallasCounts:
         got = engine.evaluate_grid_counts(CASES, backend="pallas")
         assert got == want
 
+    def test_rect_non_prefix_masks(self):
+        """The RECTANGULAR kernel (verdict_counts_pallas_rect) — the
+        per-device program of the mesh fast path: Ns != Nd and validity
+        as arbitrary per-side masks (a shard's rows are a window of the
+        global pod axis, not a prefix, and dead pods can sit anywhere).
+        Pinned against the oracle-checked single-device grids restricted
+        to the same window/masks."""
+        import numpy as np
+
+        from cyclonus_tpu.engine.pallas_kernel import (
+            sum_partials,
+            verdict_counts_pallas_rect,
+        )
+        from cyclonus_tpu.engine.tiled import _precompute_jit
+
+        policy, pods, namespaces = fuzz_problem(16, n_extra_pods=10)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        n = len(pods)
+        n_b = engine._tensors["pod_ns_id"].shape[0]  # bucketed axis
+        assert n_b > n  # pad rows in play
+        pre = _precompute_jit(engine._tensors_with_cases(CASES))
+        e, ig = pre["egress"], pre["ingress"]
+        ing, egr, comb = full_grids(engine, CASES)  # [Q, N, N] real pods
+
+        base = np.arange(n_b) < n
+        q = len(CASES)
+
+        for src0, holes_src, holes_dst in [
+            (3, [4, 7], [0, 5]),  # src window into the axis, holes both sides
+            (0, [], [1, 2, 9]),  # full src, dst holes only
+            (n - 2, [n - 1], []),  # window straddling the real/pad boundary
+        ]:
+            src_ok = base.copy()
+            src_ok[holes_src] = False
+            dst_ok = base.copy()
+            dst_ok[holes_dst] = False
+            partials = verdict_counts_pallas_rect(
+                e["tmatch"][:, src0:],
+                e["has_target"][src0:],
+                e["tallow_bf"],
+                ig["tmatch"],
+                ig["has_target"],
+                ig["tallow_bf"][:, src0:],
+                valid_src=src_ok[src0:],
+                valid_dst=dst_ok,
+                interpret=True,
+            )
+            got = sum_partials(partials, q, 0)
+            srcsel = [s for s in range(src0, n) if src_ok[s]]
+            dstsel = [d for d in range(n) if dst_ok[d]]
+            sel = np.ix_(range(q), srcsel, dstsel)
+            sel_t = np.ix_(range(q), dstsel, srcsel)  # ingress is [Q, dst, src]
+            assert got["ingress"] == int(ing[sel_t].sum()), (src0, holes_src, holes_dst)
+            assert got["egress"] == int(egr[sel].sum()), (src0, holes_src, holes_dst)
+            assert got["combined"] == int(comb[sel].sum()), (src0, holes_src, holes_dst)
+
+    def test_rect_dst_window(self):
+        """Rect with the DST side windowed/masked instead (Ns > Nd): the
+        opposite orientation of the mesh path's slicing."""
+        import numpy as np
+
+        from cyclonus_tpu.engine.pallas_kernel import (
+            sum_partials,
+            verdict_counts_pallas_rect,
+        )
+        from cyclonus_tpu.engine.tiled import _precompute_jit
+
+        policy, pods, namespaces = fuzz_problem(17, n_extra_pods=9)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        n = len(pods)
+        n_b = engine._tensors["pod_ns_id"].shape[0]
+        pre = _precompute_jit(engine._tensors_with_cases(CASES))
+        e, ig = pre["egress"], pre["ingress"]
+        ing, egr, comb = full_grids(engine, CASES)
+
+        dst0 = 2
+        base = np.arange(n_b) < n
+        src_ok = base.copy()
+        src_ok[[6]] = False
+        dst_ok = base.copy()
+        dst_ok[[3, 8]] = False
+        q = len(CASES)
+        partials = verdict_counts_pallas_rect(
+            e["tmatch"],
+            e["has_target"],
+            e["tallow_bf"][:, dst0:],
+            ig["tmatch"][:, dst0:],
+            ig["has_target"][dst0:],
+            ig["tallow_bf"],
+            valid_src=src_ok,
+            valid_dst=dst_ok[dst0:],
+            interpret=True,
+        )
+        got = sum_partials(partials, q, 0)
+        srcsel = [s for s in range(n) if src_ok[s]]
+        dstsel = [d for d in range(dst0, n) if dst_ok[d]]
+        sel = np.ix_(range(q), srcsel, dstsel)
+        sel_t = np.ix_(range(q), dstsel, srcsel)
+        assert got["ingress"] == int(ing[sel_t].sum())
+        assert got["egress"] == int(egr[sel].sum())
+        assert got["combined"] == int(comb[sel].sum())
+
+    def test_dtype_flip_without_cache_clear(self):
+        """CYCLONUS_PALLAS_DTYPE is now resolved OUTSIDE the jit and
+        passed as a static argument: flipping it mid-process retraces
+        instead of silently reusing the previous dtype's executable — no
+        jax.clear_caches() around this test, which is the point."""
+        from cyclonus_tpu.engine.pallas_kernel import (
+            sum_partials,
+            verdict_counts_pallas_rect,
+        )
+        from cyclonus_tpu.engine.tiled import _precompute_jit
+
+        policy, pods, namespaces = fuzz_problem(18, n_extra_pods=5)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        pre = _precompute_jit(engine._tensors_with_cases(CASES))
+        e, ig = pre["egress"], pre["ingress"]
+        args = (
+            e["tmatch"], e["has_target"], e["tallow_bf"],
+            ig["tmatch"], ig["has_target"], ig["tallow_bf"],
+        )
+        q = len(CASES)
+        got = {
+            od: sum_partials(
+                verdict_counts_pallas_rect(
+                    *args, interpret=True, operand_dtype=od
+                ),
+                q,
+                0,
+            )
+            for od in ("int8", "bf16", "int8")
+        }
+        assert got["int8"] == got["bf16"]
+
     def test_selector_match_np_twin(self):
         """The numpy selector evaluator that drives dead-target compaction
         must agree with the device kernel op for op — fuzzed over random

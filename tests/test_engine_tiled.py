@@ -231,6 +231,26 @@ class TestTiledCounts:
         assert counts["egress"] == int(egr.sum())
         assert counts["combined"] == int(comb.sum())
 
+    @pytest.mark.parametrize("seed,block", [(9, 2), (10, 8)])
+    def test_counts_sharded_pallas_kernel(self, seed, block):
+        """The production multi-chip FAST path: kernel="pallas" forces
+        the fused rectangular verdict+count kernel per device (interpret
+        mode on the CPU mesh, Mosaic-compiled on TPU) — pinned against
+        the single-device kernel exactly like the xla tile loop, and
+        against the xla mesh path's full result dict."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=11)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ing, egr, comb = full_grids(engine, CASES)
+        counts = engine.evaluate_grid_counts_sharded(
+            CASES, block=block, kernel="pallas"
+        )
+        assert counts["ingress"] == int(ing.sum())
+        assert counts["egress"] == int(egr.sum())
+        assert counts["combined"] == int(comb.sum())
+        assert counts == engine.evaluate_grid_counts_sharded(
+            CASES, block=block, kernel="xla"
+        )
+
 
 class TestTiledBlocks:
     # (7, 3): 14 pods bucket to a 16-row pod axis — a block size that

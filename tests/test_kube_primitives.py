@@ -149,3 +149,84 @@ class TestLabelSelector:
         assert is_labels_match_label_selector({"a": "b", "k": "z"}, sel)
         assert not is_labels_match_label_selector({"a": "b"}, sel)
         assert not is_labels_match_label_selector({"k": "z"}, sel)
+
+
+class TestCondensedModelParity:
+    """Field-for-field coverage of the reference's condensed policy type
+    model (pkg/kube/netpol/condensed-model.go:1-73): every type, field,
+    and constant in that standalone redeclaration of the k8s netpol API
+    must have a counterpart in kube/netpol.py, so a reference user finds
+    the full model surface here.  (The reference file is a TYPE corpus,
+    not fixtures — basic.go / complicated.go / pathological.go are ported
+    as fixture corpora in kube/pathological.py.)"""
+
+    def test_type_surface(self):
+        import dataclasses
+
+        from cyclonus_tpu.kube import netpol as m
+
+        want = {
+            # condensed-model.go type -> (our class, Go field -> our field)
+            "NetworkPolicySpec": (
+                m.NetworkPolicySpec,
+                {
+                    "PodSelector": "pod_selector",
+                    "Ingress": "ingress",
+                    "Egress": "egress",
+                    "PolicyTypes": "policy_types",
+                },
+            ),
+            "NetworkPolicyIngressRule": (
+                m.NetworkPolicyIngressRule,
+                {"Ports": "ports", "From": "from_"},
+            ),
+            "NetworkPolicyEgressRule": (
+                m.NetworkPolicyEgressRule,
+                {"Ports": "ports", "To": "to"},
+            ),
+            "NetworkPolicyPort": (
+                m.NetworkPolicyPort,
+                {"Protocol": "protocol", "Port": "port"},
+            ),
+            "NetworkPolicyPeer": (
+                m.NetworkPolicyPeer,
+                {
+                    "PodSelector": "pod_selector",
+                    "NamespaceSelector": "namespace_selector",
+                    "IPBlock": "ip_block",
+                },
+            ),
+            "IPBlock": (m.IPBlock, {"CIDR": "cidr", "Except": "except_"}),
+            "LabelSelector": (
+                m.LabelSelector,
+                {"MatchExpressions": "match_expressions"},
+            ),
+            "LabelSelectorRequirement": (
+                m.LabelSelectorRequirement,
+                {"Key": "key", "Operator": "operator", "Values": "values"},
+            ),
+        }
+        for go_type, (cls, fields) in want.items():
+            names = {f.name for f in dataclasses.fields(cls)}
+            for go_field, our_field in fields.items():
+                assert our_field in names, (go_type, go_field, our_field)
+        # MatchLabels is stored order-preserving as items; the make()
+        # constructor and accessor expose the map form
+        sel = m.LabelSelector.make(match_labels={"a": "b"})
+        assert sel.match_labels == {"a": "b"}
+
+    def test_constants(self):
+        from cyclonus_tpu.kube import netpol as m
+
+        # Protocol consts (condensed-model.go:41-46)
+        assert m.PROTOCOL_TCP == "TCP"
+        assert m.PROTOCOL_UDP == "UDP"
+        assert m.PROTOCOL_SCTP == "SCTP"
+        # PolicyType consts (:48-53) are plain strings in specs
+        assert m.POLICY_TYPE_INGRESS == "Ingress"
+        assert m.POLICY_TYPE_EGRESS == "Egress"
+        # LabelSelectorOperator consts (:66-72)
+        assert m.OP_IN == "In"
+        assert m.OP_NOT_IN == "NotIn"
+        assert m.OP_EXISTS == "Exists"
+        assert m.OP_DOES_NOT_EXIST == "DoesNotExist"

@@ -174,6 +174,51 @@ class TestSimulatedRunner:
                     b[k].combined,
                 ), (fr, to, k)
 
+    def test_tpu_engine_demotes_on_dead_backend(self, monkeypatch):
+        """A dead/wedged accelerator backend must DEMOTE the tpu engine
+        to the host path with identical verdicts — not hang the probe
+        (round-3 failure: `generate --mock` blocked 300s+ on a dead
+        tunnel because the simulated runner initialized the backend
+        unbounded)."""
+        import cyclonus_tpu.probe.runner as runner_mod
+
+        r = make_resources()
+        policy = build_network_policies(True, load_policies_from_yaml(DENY_ALL_Y))
+        monkeypatch.setattr(runner_mod, "accelerator_available", lambda: False)
+        runner = new_simulated_runner(policy, engine="tpu")
+        table = runner.run_probe_for_config(
+            ProbeConfig.port_protocol_config(IntOrString(80), "TCP"), r
+        )
+        assert runner.job_runner.engine in ("native", "oracle")  # demoted
+        want = new_simulated_runner(policy, engine="oracle").run_probe_for_config(
+            ProbeConfig.port_protocol_config(IntOrString(80), "TCP"), r
+        )
+        for fr, to in want.wrapped.keys():
+            a = want.get(fr, to).job_results
+            b = table.get(fr, to).job_results
+            assert set(a) == set(b)
+            for k in a:
+                assert a[k].combined == b[k].combined, (fr, to, k)
+
+    def test_accelerator_available_probe(self, monkeypatch):
+        """The bounded probe: available on this (CPU) backend, cached
+        after the first call, and trust-without-probe when the timeout
+        knob is <= 0."""
+        import cyclonus_tpu.probe.runner as runner_mod
+
+        monkeypatch.setattr(
+            runner_mod, "_BACKEND_STATE", {"checked": False, "available": False}
+        )
+        assert runner_mod.accelerator_available(timeout_s=60) is True
+        assert runner_mod._BACKEND_STATE["checked"] is True
+        # cached: a poisoned cache is returned as-is, no re-probe
+        runner_mod._BACKEND_STATE["available"] = False
+        assert runner_mod.accelerator_available(timeout_s=60) is False
+        monkeypatch.setattr(
+            runner_mod, "_BACKEND_STATE", {"checked": False, "available": False}
+        )
+        assert runner_mod.accelerator_available(timeout_s=0) is True
+
     def test_bad_buckets_in_table(self):
         r = make_resources()
         policy = build_network_policies(True, [])
