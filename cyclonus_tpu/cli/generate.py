@@ -20,6 +20,13 @@ def setup_generate(sub) -> None:
     )
     cmd.add_argument("--mock", action="store_true", help="use an in-memory mock cluster")
     cmd.add_argument(
+        "--loopback",
+        action="store_true",
+        help="use the loopback cluster: pods as real processes on 127.x "
+        "addresses, probes as real TCP/UDP through the in-pod worker "
+        "(kube/loopback.py; SCTP unsupported and dropped)",
+    )
+    cmd.add_argument(
         "--perfect-cni",
         action="store_true",
         help="with --mock: emulate a policy-correct CNI (all cases should pass)",
@@ -129,12 +136,9 @@ def run_generate(args) -> int:
     validate_tags(args.include)
     validate_tags(excluded)
 
-    if args.mock:
-        kubernetes: IKubernetes = MockKubernetes(1.0)
-    else:
-        from ..kube.kubectl import KubectlKubernetes
+    from ._cluster import close_cluster, make_cluster, perturbation_wait_seconds
 
-        kubernetes = KubectlKubernetes(args.context)
+    kubernetes, protocols = make_cluster(args, protocols)
 
     resources = Resources.new_default(
         kubernetes,
@@ -189,7 +193,7 @@ def run_generate(args) -> int:
         reset_cluster_before_test_case=True,
         verify_cluster_state_before_test_case=True,
         kube_probe_retries=args.retries,
-        perturbation_wait_seconds=0 if args.mock else args.perturbation_wait_seconds,
+        perturbation_wait_seconds=perturbation_wait_seconds(args),
         batch_jobs=args.batch_jobs,
         ignore_loopback=args.ignore_loopback,
         simulated_engine=args.engine,
@@ -241,4 +245,5 @@ def run_generate(args) -> int:
                 kubernetes.delete_namespace(ns)
             except Exception as e:
                 print(f"unable to delete namespace {ns}: {e}")
+    close_cluster(kubernetes)
     return 0

@@ -24,6 +24,12 @@ def setup_probe(sub) -> None:
     cmd = sub.add_parser("probe", help="run a connectivity probe against a cluster")
     cmd.add_argument("--mock", action="store_true", help="use an in-memory mock cluster")
     cmd.add_argument(
+        "--loopback",
+        action="store_true",
+        help="use the loopback cluster: pods as real processes on 127.x "
+        "addresses, real TCP/UDP probes (kube/loopback.py; SCTP dropped)",
+    )
+    cmd.add_argument(
         "--perfect-cni", action="store_true",
         help="with --mock: emulate a policy-correct CNI",
     )
@@ -82,12 +88,9 @@ def run_probe(args) -> int:
     ports = args.server_port or [80, 81]
     protocols = [p.upper() for p in (args.server_protocol or ["TCP", "UDP", "SCTP"])]
 
-    if args.mock:
-        kubernetes: IKubernetes = MockKubernetes(1.0)
-    else:
-        from ..kube.kubectl import KubectlKubernetes
+    from ._cluster import close_cluster, make_cluster, perturbation_wait_seconds
 
-        kubernetes = KubectlKubernetes(args.context)
+    kubernetes, protocols = make_cluster(args, protocols)
 
     resources = Resources.new_default(
         kubernetes,
@@ -123,7 +126,7 @@ def run_probe(args) -> int:
     )
     config = InterpreterConfig(
         kube_probe_retries=0,
-        perturbation_wait_seconds=0 if args.mock else args.perturbation_wait_seconds,
+        perturbation_wait_seconds=perturbation_wait_seconds(args),
         simulated_engine=args.engine,
         pod_wait_timeout_seconds=args.pod_creation_timeout_seconds,
         ignore_loopback=args.ignore_loopback,
@@ -132,4 +135,5 @@ def run_probe(args) -> int:
     result = interpreter.execute_test_case(test_case)
     printer = Printer(noisy=args.noisy, ignore_loopback=args.ignore_loopback)
     printer.print_test_case_result(result)
+    close_cluster(kubernetes)
     return 0

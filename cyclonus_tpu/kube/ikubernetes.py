@@ -185,7 +185,12 @@ class MockKubernetes(IKubernetes):
         return obj
 
     def delete_namespace(self, namespace: str) -> None:
-        self._ns(namespace)
+        ns = self._ns(namespace)
+        # dropping a namespace drops its policies: policy-aware exec
+        # hooks cache their compiled policy keyed on this rev (mockcni,
+        # loopback) and would otherwise keep enforcing ghost policies
+        if ns.netpols:
+            self.policy_rev += 1
         del self.namespaces[namespace]
 
     # network policies

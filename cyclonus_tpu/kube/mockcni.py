@@ -62,12 +62,26 @@ class PolicyAwareMockExec:
         return None
 
     def _verdict(self, namespace: str, pod: str, host: str, port: int, protocol: str) -> bool:
-        src_pod = self.mock.get_pod(namespace, pod)
         dest = self._find_dest_pod(host)
         if dest is None:
             return False  # unreachable host
         dest_ns, dest_pod = dest
+        return self._verdict_resolved(
+            namespace, self.mock.get_pod(namespace, pod), dest_ns, dest_pod, port, protocol
+        )
 
+    def _verdict_resolved(
+        self,
+        src_ns: str,
+        src_pod: KubePod,
+        dest_ns: str,
+        dest_pod: KubePod,
+        port: int,
+        protocol: str,
+    ) -> bool:
+        """Verdict with both endpoints already resolved — the loopback
+        cluster's verdict-map rebuild iterates pod objects directly and
+        must not pay _find_dest_pod's linear scan per pair."""
         # the port must actually be served on this protocol
         serving = any(
             p.container_port == port and p.protocol == protocol
@@ -94,8 +108,8 @@ class PolicyAwareMockExec:
             source=TrafficPeer(
                 internal=InternalPeer(
                     pod_labels=src_pod.labels,
-                    namespace_labels=self.mock.get_namespace(namespace).labels,
-                    namespace=namespace,
+                    namespace_labels=self.mock.get_namespace(src_ns).labels,
+                    namespace=src_ns,
                 ),
                 ip=src_pod.pod_ip,
             ),

@@ -4,6 +4,7 @@ batch, issue the probes concurrently, print JSON results."""
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 from concurrent.futures import ThreadPoolExecutor
 from typing import List
@@ -15,7 +16,28 @@ RETRIES = 1
 
 
 def _issue_one(request: Request) -> Result:
-    """worker.go:60-84 with one retry (worker.go:62-68)."""
+    """worker.go:60-84 with one retry (worker.go:62-68).
+
+    CYCLONUS_CONNECT_NATIVE=1 probes with python sockets instead of
+    shelling to /agnhost — the loopback cluster's mode (kube/loopback.py),
+    where the worker runs as a real subprocess on a machine without the
+    agnhost binary and binds CYCLONUS_SOURCE_IP so the destination pod
+    server sees the probing pod's address."""
+    if os.environ.get("CYCLONUS_CONNECT_NATIVE") == "1":
+        from ..kube.loopback import native_probe
+
+        last_err = ""
+        for _attempt in range(1 + RETRIES):
+            err = native_probe(
+                request.host,
+                request.port,
+                request.protocol,
+                source_ip=os.environ.get("CYCLONUS_SOURCE_IP") or None,
+            )
+            if err is None:
+                return Result(request=request, output="connected")
+            last_err = err
+        return Result(request=request, output="", error=last_err)
     command = request.command()
     last_err = ""
     out = ""

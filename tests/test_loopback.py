@@ -1,0 +1,290 @@
+"""End-to-end tests of the loopback cluster (kube/loopback.py): pods as
+REAL processes on dedicated 127.x.y.z addresses, probes as REAL TCP
+connects / UDP datagrams (source-bound, so enforcement keys on true peer
+IPs), the in-pod batch prober as a REAL worker subprocess.  The
+environment's substitute for the reference's KinD flow
+(hack/kind/run-cyclonus.sh — no docker/kind/netfilter exists here; see
+docs/LOOPBACK.md)."""
+
+import pytest
+
+from cyclonus_tpu.connectivity import Interpreter, InterpreterConfig
+from cyclonus_tpu.generator import TestCaseGenerator, create_policy, read_network_policies
+from cyclonus_tpu.generator.tags import StringSet
+from cyclonus_tpu.generator.testcase import TestCase, TestStep
+from cyclonus_tpu.kube.loopback import LoopbackKubernetes, native_probe
+from cyclonus_tpu.kube.netpol import (
+    IntOrString,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.probe.probeconfig import PROBE_MODE_SERVICE_NAME, ProbeConfig
+from cyclonus_tpu.probe.resources import Resources
+
+
+def small_cluster(lb, namespaces=("x", "y"), pods=("a", "b")):
+    return Resources.new_default(
+        lb,
+        list(namespaces),
+        list(pods),
+        [80, 81],
+        ["TCP", "UDP"],
+        pod_creation_timeout_seconds=15,
+    )
+
+
+class TestLoopbackSockets:
+    def test_enforcement_over_real_sockets(self):
+        """Allow / deny / unserved-port / source-attribution semantics,
+        each observed through an actual socket operation."""
+        with LoopbackKubernetes() as lb:
+            small_cluster(lb)
+            pa, pb = lb.get_pod("x", "a"), lb.get_pod("y", "b")
+            assert pa.pod_ip.startswith("127.") and pb.pod_ip.startswith("127.")
+
+            # no policies: served combos answer, unserved port is a REAL
+            # kernel refusal (no process listens there)
+            assert native_probe(pb.pod_ip, 80, "TCP", source_ip=pa.pod_ip) is None
+            assert native_probe(pb.pod_ip, 81, "UDP", source_ip=pa.pod_ip) is None
+            err = native_probe(pb.pod_ip, 99, "TCP", source_ip=pa.pod_ip)
+            assert err and "refused" in err.lower()
+
+            # deny-all-ingress in y: a->b blocked on both protocols, the
+            # reverse direction (ns x has no policy) stays open — the
+            # server can only distinguish these via true source IPs
+            lb.create_network_policy(
+                NetworkPolicy(
+                    name="deny",
+                    namespace="y",
+                    spec=NetworkPolicySpec(
+                        pod_selector=LabelSelector.make(),
+                        policy_types=["Ingress"],
+                    ),
+                )
+            )
+            assert native_probe(pb.pod_ip, 80, "TCP", source_ip=pa.pod_ip) == "closed without ack"
+            assert native_probe(pb.pod_ip, 80, "UDP", source_ip=pa.pod_ip) == "timeout"
+            assert native_probe(pa.pod_ip, 80, "TCP", source_ip=pb.pod_ip) is None
+
+            # allow from pod a only: label-selector enforcement per peer
+            lb.update_network_policy(
+                NetworkPolicy(
+                    name="deny",
+                    namespace="y",
+                    spec=NetworkPolicySpec(
+                        pod_selector=LabelSelector.make(),
+                        policy_types=["Ingress"],
+                        ingress=[
+                            NetworkPolicyIngressRule(
+                                ports=[],
+                                from_=[
+                                    NetworkPolicyPeer(
+                                        pod_selector=LabelSelector.make(
+                                            match_labels={"pod": "a"}
+                                        ),
+                                        namespace_selector=LabelSelector.make(),
+                                    )
+                                ],
+                            )
+                        ],
+                    ),
+                )
+            )
+            assert native_probe(pb.pod_ip, 80, "TCP", source_ip=pa.pod_ip) is None
+            b_self = lb.get_pod("x", "b")
+            assert (
+                native_probe(pb.pod_ip, 80, "TCP", source_ip=b_self.pod_ip)
+                == "closed without ack"
+            )
+
+    def test_pod_lifecycle_frees_address(self):
+        """delete_pod kills the server process; its ports refuse.  Also:
+        a probe from a NON-pod source (unbound client = 127.0.0.1) is
+        denied — the verdict map only contains pod addresses."""
+        with LoopbackKubernetes() as lb:
+            small_cluster(lb, namespaces=("x",), pods=("a", "b"))
+            pa, pb = lb.get_pod("x", "a"), lb.get_pod("x", "b")
+            ip = pb.pod_ip
+            assert native_probe(ip, 80, "TCP", source_ip=pa.pod_ip) is None
+            assert native_probe(ip, 80, "TCP") == "closed without ack"
+            lb.delete_pod("x", "b")
+            err = native_probe(ip, 80, "TCP", source_ip=pa.pod_ip)
+            assert err and ("refused" in err.lower() or "timeout" in err)
+
+    def test_worker_subprocess_batch(self):
+        """The real in-pod worker: a subprocess speaking the JSON batch
+        protocol over native sockets, mixed verdicts in one batch."""
+        import json
+
+        with LoopbackKubernetes() as lb:
+            small_cluster(lb)
+            pa, pb = lb.get_pod("x", "a"), lb.get_pod("y", "b")
+            lb.create_network_policy(
+                NetworkPolicy(
+                    name="deny",
+                    namespace="y",
+                    spec=NetworkPolicySpec(
+                        pod_selector=LabelSelector.make(),
+                        policy_types=["Ingress"],
+                    ),
+                )
+            )
+            batch = json.dumps(
+                {
+                    "Namespace": "x",
+                    "Pod": "a",
+                    "Container": "cont-80-tcp",
+                    "Requests": [
+                        {"Key": "blocked", "Protocol": "tcp", "Host": pb.pod_ip, "Port": 80},
+                        {"Key": "open", "Protocol": "tcp", "Host": pa.pod_ip, "Port": 80},
+                    ],
+                }
+            )
+            out, _err_out, err = lb.execute_remote_command(
+                "x", "a", "cont-80-tcp", ["/worker", "--jobs", batch]
+            )
+            assert err is None
+            results = {r["Request"]["Key"]: r for r in json.loads(out)}
+            assert results["blocked"]["Error"] != ""
+            assert results["open"]["Error"] == ""
+            assert results["open"]["Output"] == "connected"
+
+
+def loopback_interpreter(lb, resources, batch_jobs=False):
+    return Interpreter(
+        lb,
+        resources,
+        InterpreterConfig(
+            reset_cluster_before_test_case=True,
+            verify_cluster_state_before_test_case=True,
+            kube_probe_retries=0,
+            perturbation_wait_seconds=0,
+            batch_jobs=batch_jobs,
+            simulated_engine="oracle",
+            pod_wait_timeout_seconds=15,
+        ),
+    )
+
+
+class TestLoopbackInterpreter:
+    @pytest.mark.parametrize("batch_jobs", [False, True])
+    def test_one_off_probe_matches_simulated(self, batch_jobs):
+        """The full interpreter loop over real sockets: apply example
+        policies, probe every pod pair via the kube (exec) path —
+        per-job agnhost style or the batch worker — and require the
+        real table to equal the simulated one (result.passed())."""
+        with LoopbackKubernetes() as lb:
+            resources = Resources.new_default(
+                lb,
+                ["x", "y", "z"],
+                ["a", "b"],
+                [80, 81],
+                ["TCP", "UDP"],
+                pod_creation_timeout_seconds=15,
+                batch_jobs=batch_jobs,
+            )
+            policies = [
+                # deny-all ingress in y + allow back only from x/a pods
+                NetworkPolicy(
+                    name="deny-all-y",
+                    namespace="y",
+                    spec=NetworkPolicySpec(
+                        pod_selector=LabelSelector.make(),
+                        policy_types=["Ingress"],
+                    ),
+                ),
+                NetworkPolicy(
+                    name="allow-a-to-y",
+                    namespace="y",
+                    spec=NetworkPolicySpec(
+                        pod_selector=LabelSelector.make(),
+                        policy_types=["Ingress"],
+                        ingress=[
+                            NetworkPolicyIngressRule(
+                                ports=[],
+                                from_=[
+                                    NetworkPolicyPeer(
+                                        pod_selector=LabelSelector.make(
+                                            match_labels={"pod": "a"}
+                                        ),
+                                        namespace_selector=LabelSelector.make(
+                                            match_labels={"ns": "x"}
+                                        ),
+                                    )
+                                ],
+                            )
+                        ],
+                    ),
+                ),
+            ]
+            actions = [read_network_policies(["x", "y", "z"])]
+            for policy in policies:
+                actions.append(create_policy(policy))
+            case = TestCase(
+                description="loopback one-off",
+                tags=StringSet(),
+                steps=[
+                    TestStep(
+                        probe=ProbeConfig.port_protocol_config(
+                            IntOrString(80), "TCP", PROBE_MODE_SERVICE_NAME
+                        ),
+                        actions=actions,
+                    )
+                ],
+            )
+            result = loopback_interpreter(
+                lb, resources, batch_jobs=batch_jobs
+            ).execute_test_case(case)
+            assert result.err is None, result.err
+            assert result.passed(ignore_loopback=False), "real != simulated"
+
+
+@pytest.mark.conformance
+class TestLoopbackConformance:
+    def test_conflict_cases(self, tmp_path):
+        """The 16 conflict-family conformance cases through the
+        interpreter over the loopback cluster — the KinD-flow analog
+        (`--include conflict`, journaled).  The committed artifact
+        artifacts/loopback-conformance-journal.jsonl comes from the same
+        flow via `generate --loopback`."""
+        from cyclonus_tpu.connectivity.journal import Journal
+
+        with LoopbackKubernetes() as lb:
+            resources = Resources.new_default(
+                lb,
+                ["x", "y", "z"],
+                ["a", "b", "c"],
+                [80, 81],
+                ["TCP", "UDP"],
+                pod_creation_timeout_seconds=15,
+            )
+            zc = resources.get_pod("z", "c")
+            generator = TestCaseGenerator(
+                allow_dns=True,
+                pod_ip=zc.ip,
+                namespaces=["x", "y", "z"],
+                tags=["conflict"],
+                excluded_tags=["multi-peer", "upstream-e2e", "example"],
+            )
+            cases = generator.generate_test_cases()
+            assert len(cases) == 16
+            journal = Journal(str(tmp_path / "journal.jsonl"))
+            interpreter = loopback_interpreter(lb, resources)
+            failed = []
+            for i, tc in enumerate(cases):
+                result = interpreter.execute_test_case(tc)
+                ok = result.passed(ignore_loopback=False)
+                journal.record(
+                    tc.description,
+                    passed=ok,
+                    step_count=len(result.steps),
+                    tags=tc.tags.keys_sorted(),
+                    error=str(result.err) if result.err else "",
+                    key=f"{i}:{tc.description}",
+                )
+                if not ok:
+                    failed.append(tc.description)
+            assert not failed, failed
