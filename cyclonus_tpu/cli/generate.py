@@ -8,7 +8,6 @@ from typing import List
 from ..connectivity import Interpreter, InterpreterConfig, Printer
 from ..generator import TestCaseGenerator
 from ..generator.tags import validate_tags
-from ..kube.ikubernetes import IKubernetes, MockKubernetes
 from ..probe.probeconfig import ALL_PROBE_MODES, ProbeMode
 from ..probe.resources import Resources
 from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
@@ -212,6 +211,7 @@ def run_generate(args) -> int:
 
     from ..utils.tracing import jax_profile, render_stats
 
+    failed = 0
     with jax_profile(args.jax_profile):
         for i, tc in enumerate(cases):
             # descriptions are not unique across cases; the index in the
@@ -225,6 +225,8 @@ def run_generate(args) -> int:
             print(f"starting test case #{i + 1} ({tc.description})")
             result = interpreter.execute_test_case(tc)
             printer.print_test_case_result(result)
+            if not result.passed(args.ignore_loopback):
+                failed += 1
             if journal is not None:
                 journal.record(
                     tc.description,
@@ -246,4 +248,9 @@ def run_generate(args) -> int:
             except Exception as e:
                 print(f"unable to delete namespace {ns}: {e}")
     close_cluster(kubernetes)
+    # a conformance runner that exits 0 on failing cases gives CI a
+    # permanently green signal; the summary already printed the detail
+    if failed:
+        print(f"{failed} test case(s) FAILED")
+        return 1
     return 0

@@ -7,7 +7,6 @@ from ..connectivity import Interpreter, InterpreterConfig, Printer
 from ..generator import read_network_policies, create_policy
 from ..generator.tags import StringSet
 from ..generator.testcase import TestCase, TestStep
-from ..kube.ikubernetes import IKubernetes, MockKubernetes
 from ..kube.netpol import IntOrString
 from ..kube.yaml_io import load_policies_from_path
 from ..probe.probeconfig import (

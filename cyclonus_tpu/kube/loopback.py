@@ -114,10 +114,15 @@ class LoopbackKubernetes(MockKubernetes):
         self._oracle = PolicyAwareMockExec(self)
         self._write_verdicts()
         # pod servers are real child processes: they survive a parent
-        # crash (unlike threads) and would hold their 127.x binds forever
-        import atexit
+        # crash (unlike threads) and would hold their 127.x binds forever.
+        # weakref.finalize (not atexit.register(self.close)) so a closed/
+        # collected cluster doesn't stay pinned in the atexit table for
+        # the process lifetime; close() detaches it.
+        import weakref
 
-        atexit.register(self.close)
+        self._finalizer = weakref.finalize(
+            self, _kill_servers, self._servers, self._lock, self._tmp
+        )
 
     # --- pod lifecycle: real processes ---
 
@@ -194,18 +199,10 @@ class LoopbackKubernetes(MockKubernetes):
         self._write_verdicts()
 
     def close(self) -> None:
-        """Kill every pod server and drop the verdict dir (idempotent)."""
-        import shutil
-
-        with self._lock:
-            servers, self._servers = dict(self._servers), {}
-        for proc in servers.values():
-            try:
-                proc.kill()
-                proc.wait(timeout=5)
-            except Exception:
-                pass
-        shutil.rmtree(self._tmp, ignore_errors=True)
+        """Kill every pod server and drop the verdict dir (idempotent:
+        the finalizer runs its callback at most once — whether called
+        here, at GC, or at interpreter exit)."""
+        self._finalizer()
 
     def __enter__(self) -> "LoopbackKubernetes":
         return self
@@ -348,6 +345,24 @@ class LoopbackKubernetes(MockKubernetes):
             source_ip=pod_obj.pod_ip,
         )
         return ("", "", err)
+
+
+def _kill_servers(servers: Dict, lock: threading.Lock, tmp: str) -> None:
+    """Finalizer body: must not reference the cluster object (a bound
+    method would keep it alive in the finalizer registry).  Mutates the
+    SHARED servers dict in place — delete_pod pops from the same one."""
+    import shutil
+
+    with lock:
+        procs = list(servers.values())
+        servers.clear()
+    for proc in procs:
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+    shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _read_line_bounded(stream, timeout_s: float) -> str:

@@ -242,6 +242,63 @@ class TestLoopbackInterpreter:
             assert result.passed(ignore_loopback=False), "real != simulated"
 
 
+@pytest.mark.fuzz
+class TestLoopbackFuzz:
+    def test_random_policies_real_sockets(self):
+        """Randomized policy sets through the interpreter over the
+        loopback cluster: every seed's REAL-socket table (per-job exec
+        path) must equal the simulated table.  The real-network twin of
+        the oracle/kernel fuzz sweep (test_engine_parity.run_fuzz_seed);
+        one shared cluster, reset between cases by the interpreter."""
+        import random
+
+        from test_engine_parity import random_policy
+
+        with LoopbackKubernetes() as lb:
+            resources = Resources.new_default(
+                lb,
+                ["x", "y", "z"],
+                ["a", "b"],
+                [80, 81],
+                ["TCP", "UDP"],
+                pod_creation_timeout_seconds=15,
+            )
+            interpreter = loopback_interpreter(lb, resources)
+            keys = ["pod", "app", "tier", "ns", "team"]
+            values = ["a", "b", "c", "web", "db", "x", "y", "z", "blue", "red"]
+            failures = []
+            for seed in range(6):
+                rng = random.Random(1000 + seed)
+                policies = [
+                    random_policy(rng, i, ["x", "y", "z"], keys, values)
+                    for i in range(rng.randrange(1, 4))
+                ]
+                actions = [read_network_policies(["x", "y", "z"])]
+                actions.extend(create_policy(p) for p in policies)
+                case = TestCase(
+                    description=f"loopback fuzz seed {seed}",
+                    tags=StringSet(),
+                    steps=[
+                        TestStep(
+                            probe=ProbeConfig.port_protocol_config(
+                                IntOrString(80), "TCP", PROBE_MODE_SERVICE_NAME
+                            ),
+                            actions=actions,
+                        ),
+                        TestStep(
+                            probe=ProbeConfig.port_protocol_config(
+                                IntOrString(81), "UDP", PROBE_MODE_SERVICE_NAME
+                            ),
+                            actions=[],
+                        ),
+                    ],
+                )
+                result = interpreter.execute_test_case(case)
+                if result.err is not None or not result.passed(ignore_loopback=False):
+                    failures.append((seed, str(result.err)))
+            assert not failures, failures
+
+
 @pytest.mark.conformance
 class TestLoopbackConformance:
     def test_conflict_cases(self, tmp_path):
