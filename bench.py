@@ -263,28 +263,36 @@ def run_compiled_parity(rng):
     if jax.default_backend() != "tpu":
         return {"cases": 0, "ok": None, "skipped": "not on tpu"}
     cases_spec = [
-        # (pods, policies, compact, dtype) — compact=False forces the
-        # multi-chunk general kernel (dead targets stay, T > 1024).
-        # Pod counts bucket to 2048/3072/4096/5120/6144 respectively.
-        (2048, 300, True, "int8"),
-        (2304, 300, True, "bf16"),  # odd pod count: bucketing pads
-        (4096, 1500, False, "int8"),
-        (4104, 1500, False, "bf16"),  # -> 5120 bucket
-        (6144, 600, True, "int8"),
+        # (pods, policies, compact, dtype, slab) — compact=False forces
+        # the multi-chunk general kernel (dead targets stay, T > 1024);
+        # slab=True forces the per-tile target-slab kernel (eligible at
+        # >= 2*SLAB_BS bucketed pods).  Pod counts bucket to
+        # 2048/3072/4096/5120/6144/8192 respectively.
+        (2048, 300, True, "int8", False),
+        (2304, 300, True, "bf16", False),  # odd pod count: bucketing pads
+        (4096, 1500, False, "int8", False),
+        (4104, 1500, False, "bf16", False),  # -> 5120 bucket
+        (6144, 600, True, "int8", False),
+        (8192, 800, True, "int8", True),  # Mosaic-compiles the slab kernel
     ]
     port_cases = [
         PortCase(80, "serve-80-tcp", "TCP"),
         PortCase(81, "serve-81-udp", "UDP"),
     ]
     failures = []
-    for pods_n, pols_n, compact, dtype in cases_spec:
+    for pods_n, pols_n, compact, dtype, slab in cases_spec:
         saved = {
             k: os.environ.get(k)
-            for k in ("CYCLONUS_COMPACT", "CYCLONUS_PALLAS_DTYPE")
+            for k in (
+                "CYCLONUS_COMPACT",
+                "CYCLONUS_PALLAS_DTYPE",
+                "CYCLONUS_PALLAS_SLAB",
+            )
         }
         try:
             os.environ["CYCLONUS_COMPACT"] = "1" if compact else "0"
             os.environ["CYCLONUS_PALLAS_DTYPE"] = dtype
+            os.environ["CYCLONUS_PALLAS_SLAB"] = "1" if slab else "0"
             pods, namespaces, policies = build_synthetic(
                 pods_n, pols_n, random.Random(rng.randrange(1 << 30))
             )
@@ -294,8 +302,13 @@ def run_compiled_parity(rng):
             want = engine.evaluate_grid_counts(port_cases, backend="xla")
             if got != want:
                 failures.append(
-                    {"case": [pods_n, pols_n, compact, dtype],
+                    {"case": [pods_n, pols_n, compact, dtype, slab],
                      "pallas": got, "xla": want}
+                )
+            if slab and engine._slab_plan_state is None:
+                failures.append(
+                    {"case": [pods_n, pols_n, compact, dtype, slab],
+                     "error": "slab case fell back (plan ineligible)"}
                 )
         finally:
             for k, v in saved.items():

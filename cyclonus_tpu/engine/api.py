@@ -242,7 +242,7 @@ _PEER_KEYS = (
 )
 
 
-def _compact_dead_targets(tensors: Dict) -> Dict:
+def _compact_dead_targets(tensors: Dict, selpod: Optional[np.ndarray] = None) -> Dict:
     """Drop targets that match no pod of this cluster (and their peers).
 
     Verdicts are exactly invariant: a dead target's tmatch row is all
@@ -255,7 +255,8 @@ def _compact_dead_targets(tensors: Dict) -> Dict:
     (no heuristics), evaluated once on CPU at encode time: O(S * N),
     noise next to the O(N^2 * T) evaluation it shrinks."""
     pod_ns_id = tensors["pod_ns_id"]
-    selpod = _selector_pod_matches_host(tensors)
+    if selpod is None:
+        selpod = _selector_pod_matches_host(tensors)
     s = selpod.shape[0]
     # rows: any ns id referenced by pods or targets (vocab ns ids can
     # exceed the cluster's ns table when policies name pod-less namespaces)
@@ -604,14 +605,26 @@ class TpuPolicyEngine:
         with phase("engine.encode"):
             self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
             self._tensors = self._build_tensors()
+            # one O(S*N) host selector pass serves both consumers: dead-
+            # target compaction here and the slab-window plan later
+            # (selector and pod axes are unchanged by compaction, only
+            # padded by bucketing)
+            self._selpod_prebucket = None
             if _compaction_enabled(self._tensors):
                 with phase("engine.compact"):
-                    self._tensors = _compact_dead_targets(self._tensors)
+                    self._selpod_prebucket = _selector_pod_matches_host(
+                        self._tensors
+                    )
+                    self._tensors = _compact_dead_targets(
+                        self._tensors, selpod=self._selpod_prebucket
+                    )
             self._tensors = _bucket_tensors(_sort_targets_by_ns(self._tensors))
         self._device_tensors = None  # lazily device_put once
         self._packed_buf = None  # single-buffer device copy (all paths)
         self._unpack = None
         self._pod_perm_dev = None  # ns-order pod permutation (counts path)
+        self._pod_perm_host = None
+        self._slab_plan_state = "unset"  # -> None | {direction: t0 dev array}
         self._counts_packed_jit = None
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
@@ -818,6 +831,69 @@ class TpuPolicyEngine:
         # tallow bf16 [T, N, Q] per direction + tmatch bool [T, N] + small
         return t * n * (2 * q + 1)
 
+    def _slab_plan(self, perm: np.ndarray):
+        """Per-tile target-slab windows for the pallas slab kernel, or
+        None when it doesn't apply.
+
+        Host-side eligibility with the SAME reduction the kernel's
+        safety rests on: per direction, every pod tile's matching
+        targets (on the ns-sorted axis = perm order) must fit one
+        SLAB_W window (pallas_kernel.slab_windows).  Gated off unless
+        CYCLONUS_PALLAS_SLAB=1 — the slab path's win is the contraction
+        depth cut (2*SLAB_W vs kt_e+kt_i) and only exists on hardware;
+        flip the default once driver-measured — and the cluster spans
+        at least two src tiles (below that the single-chunk kernel is
+        already minimal).  The numpy tmatch twin here is the same
+        formula as kernel.direction_precompute, O(T*N) once per engine."""
+        import os
+
+        from .pallas_kernel import SLAB_BD, SLAB_BS, SLAB_W, slab_windows
+
+        if os.environ.get("CYCLONUS_PALLAS_SLAB", "0") != "1":
+            return None
+        n_b = int(self._tensors["pod_ns_id"].shape[0])
+        if n_b < 2 * SLAB_BS:
+            return None
+        # upper gate: the slabs are materialized [q, n_tiles, w, N] HBM
+        # copies (see verdict_counts_pallas_slab's design note); past
+        # ~150k pods their bytes explode quadratically-in-tiles and the
+        # chunked kernels win.  Budget both directions at 2 port cases.
+        n_tiles = -(-n_b // SLAB_BS) + -(-n_b // SLAB_BD)
+        if 2 * n_tiles * SLAB_W * n_b > int(
+            os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30))
+        ):
+            return None
+        import jax
+
+        n = self.encoding.cluster.n_pods
+        if self._selpod_prebucket is not None:
+            # pad the compaction-time pass to the bucketed axes: pad
+            # selector rows match nothing; pad pod columns diverge from
+            # the device (empty selectors match pads there) but every
+            # pad column is force-masked below, so False is safe
+            pre = self._selpod_prebucket
+            selpod = np.zeros(
+                (self._tensors["sel_req_kv"].shape[0], n_b), dtype=bool
+            )
+            selpod[: pre.shape[0], : pre.shape[1]] = pre
+        else:
+            selpod = _selector_pod_matches_host(self._tensors)
+        pod_ns = self._tensors["pod_ns_id"]
+        plan = {}
+        for direction, tile in (("egress", SLAB_BS), ("ingress", SLAB_BD)):
+            d = self._tensors[direction]
+            tm = d["target_ns"][:, None] == pod_ns[None, :]
+            if selpod.size and d["target_sel"].size:
+                t_sel = np.clip(d["target_sel"], 0, selpod.shape[0] - 1)
+                tm &= selpod[t_sel]
+            tm = tm[:, perm]
+            tm[:, n:] = False  # pads sort last; mirrors the kernel's mask
+            t0, ok = slab_windows(tm, tile, SLAB_W)
+            if not ok:
+                return None
+            plan[direction] = jax.device_put(t0)
+        return plan
+
     def _build_counts_jits(self) -> None:
         """Build the three counts programs once per engine: the fused
         cold-path jit (unpack + sort + precompute + pallas in one
@@ -825,7 +901,11 @@ class TpuPolicyEngine:
         the repeat path uses to keep the precompute device-resident."""
         import jax
 
-        from .pallas_kernel import _should_interpret, verdict_counts_pallas
+        from .pallas_kernel import (
+            _should_interpret,
+            verdict_counts_pallas,
+            verdict_counts_pallas_slab,
+        )
         from .sharded import _POD_KEYS
         from .tiled import _precompute
 
@@ -850,24 +930,32 @@ class TpuPolicyEngine:
             tensors["q_proto"] = q_proto
             return tensors
 
-        def counts_from_pre(pre, n_pods):
+        def counts_from_pre(pre, n_pods, t0_e=None, t0_i=None):
+            e, ig = pre["egress"], pre["ingress"]
+            if t0_e is not None:
+                # per-tile slab fast path (host-verified eligibility)
+                return verdict_counts_pallas_slab(
+                    e["tmatch"], e["has_target"], e["tallow_bf"],
+                    ig["tmatch"], ig["has_target"], ig["tallow_bf"],
+                    t0_e, t0_i, n_pods, interpret=interpret,
+                )
             return verdict_counts_pallas(
-                pre["egress"]["tmatch"],
-                pre["egress"]["has_target"],
-                pre["egress"]["tallow_bf"],
-                pre["ingress"]["tmatch"],
-                pre["ingress"]["has_target"],
-                pre["ingress"]["tallow_bf"],
+                e["tmatch"],
+                e["has_target"],
+                e["tallow_bf"],
+                ig["tmatch"],
+                ig["has_target"],
+                ig["tallow_bf"],
                 n_pods=n_pods,
                 interpret=interpret,
             )
 
         @jax.jit
-        def counts_packed(buf, perm, q_port, q_name, q_proto, n_pods):
+        def counts_packed(buf, perm, q_port, q_name, q_proto, n_pods, t0_e=None, t0_i=None):
             pre = _precompute(
                 prepared_tensors(buf, perm, q_port, q_name, q_proto)
             )
-            return counts_from_pre(pre, n_pods)
+            return counts_from_pre(pre, n_pods, t0_e, t0_i)
 
         self._counts_packed_jit = counts_packed
         self._pre_jit = jax.jit(
@@ -905,8 +993,16 @@ class TpuPolicyEngine:
             ns = self._tensors["pod_ns_id"]
             key = np.where(ns < 0, np.iinfo(np.int32).max, ns)
             perm = np.argsort(key, kind="stable").astype(np.int32)
+            self._pod_perm_host = perm
             with phase("engine.device_put"):
                 self._pod_perm_dev = jax.device_put(perm)
+        if self._slab_plan_state == "unset":
+            with phase("engine.slab_plan"):
+                self._slab_plan_state = self._slab_plan(self._pod_perm_host)
+        slab = self._slab_plan_state
+        slab_args = (
+            (slab["egress"], slab["ingress"]) if slab else (None, None)
+        )
         if self._counts_packed_jit is None:
             self._build_counts_jits()
         from .pallas_kernel import sum_partials
@@ -918,7 +1014,7 @@ class TpuPolicyEngine:
             self._pre_cache_misses = 0
             with phase("engine.dispatch"):
                 partials = self._counts_from_pre_jit(
-                    self._pre_cache[1], np.int32(n)
+                    self._pre_cache[1], np.int32(n), *slab_args
                 )
         elif (
             self._last_counts_key == key
@@ -944,7 +1040,7 @@ class TpuPolicyEngine:
                     # too big to pin: remember, so repeats go back to the
                     # single fused dispatch instead of this split path
                     self._pre_cache_declined = key
-                partials = self._counts_from_pre_jit(pre, np.int32(n))
+                partials = self._counts_from_pre_jit(pre, np.int32(n), *slab_args)
         else:
             self._last_counts_key = key
             if self._pre_cache is not None:
@@ -957,7 +1053,7 @@ class TpuPolicyEngine:
             with phase("engine.dispatch"):
                 partials = self._counts_packed_jit(
                     buf, self._pod_perm_dev, q_port, q_name, q_proto,
-                    np.int32(n),
+                    np.int32(n), *slab_args,
                 )
         # the [Q, n_tiles, 3] readback is the execution barrier: device
         # run time (and, on a remote-attached chip, any service-side

@@ -610,6 +610,271 @@ def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# --- per-tile target slabs -------------------------------------------------
+#
+# The single-chunk kernel contracts EVERY tile pair over the full live
+# target depth (kt_e + kt_i, ~640 at the 100k x 10k bench), but with
+# pods and targets namespace-sorted a 2048-pod src tile only ever
+# matches a narrow contiguous band of targets (~5-10 rows at the bench
+# shape: a target applies to pods of exactly one namespace,
+# kernel.direction_precompute).  The slab path gathers, per pod tile,
+# one fixed-width window (SLAB_W rows) of the target axis covering that
+# band — for BOTH directions — so the per-step contraction depth drops
+# from kt_e + kt_i to 2 * SLAB_W regardless of the policy count.  The
+# no-matching-target rule and the validity mask cannot ride the matmul
+# anymore (the pseudo row lives outside most windows), so they move to
+# the epilogue as two VPU OR-terms per direction, fed by four small
+# per-tile vectors.
+#
+# Eligibility is decided HOST-side (slab_windows on a numpy tmatch
+# twin): every tile's nonzero target rows must fit one SLAB_W window.
+# Ns-sorted clusters qualify overwhelmingly; anything else falls back
+# to the single/multi-chunk kernels.  r3 measured a 256-aligned
+# windowing of the INGRESS direction only at ~10-15% — consistent with
+# depth 640 -> 512; this path targets depth -> 256.
+
+SLAB_W = 128
+SLAB_BS = 2048
+SLAB_BD = 1024
+
+
+def slab_windows(tmatch: "np.ndarray", tile: int, w: int = SLAB_W):
+    """Per-tile target-window starts from a HOST (numpy, valid-masked)
+    tmatch [T, N]: returns (t0 [n_tiles] int32, ok).  ok is False when
+    any tile's nonzero rows span more than w — the caller must then use
+    the non-slab kernels.  Empty tiles get t0 = 0 (their tmatch slab is
+    all zero, so the window content is irrelevant)."""
+    import numpy as np
+
+    t, n = tmatch.shape
+    n_tiles = -(-n // tile) if n else 0
+    if n_tiles == 0 or t == 0:
+        return np.zeros(max(n_tiles, 1), dtype=np.int32), True
+    pad = n_tiles * tile - n
+    if pad:
+        tmatch = np.pad(tmatch, ((0, 0), (0, pad)))
+    nz = tmatch.reshape(t, n_tiles, tile).any(axis=2)  # [T, n_tiles]
+    any_t = nz.any(axis=0)
+    first = np.where(any_t, nz.argmax(axis=0), 0).astype(np.int32)
+    last = np.where(any_t, t - 1 - nz[::-1].argmax(axis=0), -1)
+    ok = bool(((last - first) < w).all())
+    return first, ok
+
+
+def _make_verdict_counts_kernel_slab():
+    """Kernel body for the slab path: one matmul per direction over the
+    tile's SLAB_W-deep target window (values straight into the count
+    epilogue, like the 1chunk kernel), plus the pseudo/validity OR-terms
+    the windows exclude."""
+
+    def _kernel(
+        a_e_ref,  # [1, W, BS] od — tmatch_e window for src tile i
+        b_e_ref,  # [1, 1, W, BD] od — tallow_e window (q, src tile i, dst j)
+        b_i_ref,  # [1, 1, W, BS] od — tallow_i window (q, dst tile j, src i)
+        a_i_ref,  # [1, W, BD] od — tmatch_i window for dst tile j
+        pe_ref,  # [1, BS] od — pseudo_e (valid src with no egress target)
+        vd_ref,  # [1, BD] od — valid dst
+        pi_ref,  # [1, BD] od — pseudo_i (valid dst with no ingress target)
+        vs_ref,  # [1, BS] od — valid src
+        counts_ref,  # [1, n_i, 128] int32 per-q count plane
+        cnt_ref,  # [1, 128] int32 scratch
+    ):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        n_j = pl.num_programs(2)
+
+        @pl.when((i == 0) & (j == 0))
+        def _init_counts():
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+
+        @pl.when(j == 0)
+        def _init_cnt():
+            cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+        acc_dt = jnp.int32 if a_e_ref.dtype == jnp.int8 else jnp.float32
+        # egress[s, d] = sum_w tmatch_e[w, s] * tallow_e[w, d]
+        acc_e = jax.lax.dot_general(
+            a_e_ref[0],
+            b_e_ref[0, 0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dt,
+        )
+        # ingress[s, d] = sum_w tallow_i[w, s] * tmatch_i[w, d]
+        acc_i = jax.lax.dot_general(
+            b_i_ref[0, 0],
+            a_i_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dt,
+        )
+        zero = jnp.array(0, acc_dt)
+        od_zero = jnp.array(0, a_e_ref.dtype)
+        pe = pe_ref[0] > od_zero  # [BS]
+        vd = vd_ref[0] > od_zero  # [BD]
+        pi = pi_ref[0] > od_zero  # [BD]
+        vs = vs_ref[0] > od_zero  # [BS]
+        egress = (acc_e > zero) | (pe[:, None] & vd[None, :])
+        ingress = (acc_i > zero) | (vs[:, None] & pi[None, :])
+        combined = egress & ingress
+        c_in = jnp.sum(ingress.astype(jnp.int32))
+        c_eg = jnp.sum(egress.astype(jnp.int32))
+        c_co = jnp.sum(combined.astype(jnp.int32))
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        cnt_ref[:] += (
+            jnp.where(lane == 0, c_in, 0)
+            + jnp.where(lane == 1, c_eg, 0)
+            + jnp.where(lane == 2, c_co, 0)
+        )
+
+        @pl.when(j == n_j - 1)
+        def _flush():
+            counts_ref[:, pl.ds(i, 1), :] = cnt_ref[:].reshape(1, 1, 128)
+
+    return _kernel
+
+
+def verdict_counts_pallas_slab(
+    tmatch_e: jnp.ndarray,  # [T_e, N] bool
+    has_e: jnp.ndarray,  # [N] bool
+    tallow_e: jnp.ndarray,  # [T_e, N, Q] bf16 (0/1)
+    tmatch_i: jnp.ndarray,  # [T_i, N] bool
+    has_i: jnp.ndarray,  # [N] bool
+    tallow_i: jnp.ndarray,  # [T_i, N, Q] bf16 (0/1)
+    t0_e: jnp.ndarray,  # [n_i] int32 window starts (host: slab_windows)
+    t0_i: jnp.ndarray,  # [n_j] int32
+    n_pods: int | jnp.ndarray,
+    interpret: bool = False,
+    operand_dtype: str = None,
+    bs: int = None,
+    bd: int = None,
+    w: int = None,
+) -> jnp.ndarray:
+    """[Q, n_i, 3] int32 partial counts via per-tile target slabs.  The
+    caller guarantees (via slab_windows on the SAME valid-masked tmatch,
+    with the SAME w) that every tile's nonzero target rows fit its w
+    window; violations silently undercount, which is why eligibility is
+    checked host-side with the identical reduction.  All three layout
+    defaults resolve from the module globals at CALL time so a runtime
+    override (tests monkeypatch them) can never desynchronize the host
+    check from the kernel's actual window.
+
+    Design note: the slabs are MATERIALIZED per-tile gathers — [q,
+    n_tiles, w, N] in HBM, rebuilt per dispatch — which caps this path
+    at ~150k pods (the caller gates on the byte estimate).  The
+    alternative (scalar-prefetch block maps into the original arrays,
+    like the general kernel's nz redirects) avoids the copies and the
+    cap, but block index maps are w-ALIGNED, so covering an arbitrary
+    <=w/2-wide span needs a 2-block window — doubling the contraction
+    depth and giving back most of the win at the 100k bench shape
+    (depth 512 vs this path's 256; a 256-aligned windowing measured
+    only 10-15% in round 3)."""
+    return _verdict_counts_pallas_slab(
+        tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+        t0_e, t0_i, n_pods,
+        interpret=interpret,
+        operand_dtype=_resolve_operand_dtype(operand_dtype),
+        bs=bs if bs is not None else SLAB_BS,
+        bd=bd if bd is not None else SLAB_BD,
+        w=w if w is not None else SLAB_W,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("interpret", "operand_dtype", "bs", "bd", "w")
+)
+def _verdict_counts_pallas_slab(
+    tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+    t0_e, t0_i, n_pods, interpret, operand_dtype, bs, bd, w,
+):
+    od = jnp.bfloat16 if operand_dtype == "bf16" else jnp.int8
+    n = tmatch_e.shape[1]
+    q = tallow_e.shape[2]
+    valid = (jnp.arange(n) < n_pods).astype(od)  # [N]
+
+    ns_pad = -(-max(n, 1) // bs) * bs
+    nd_pad = -(-max(n, 1) // bd) * bd
+    n_i, n_j = ns_pad // bs, nd_pad // bd
+    if bs * nd_pad >= 2**31:
+        raise ValueError(
+            f"dst axis {nd_pad} too large for int32 tile counts at bs={bs}"
+        )
+
+    def prep(tmatch, tallow, valid_match, valid_allow, n_pad_match, n_pad_allow):
+        """Valid-masked, od-cast, pod-padded operands plus a w-padded
+        target axis so every dynamic window slice is in bounds."""
+        tm = tmatch.astype(od) * valid_match[None, :]
+        tl = jnp.moveaxis(tallow, 2, 0).astype(od) * valid_allow[None, None, :]
+        tm = _pad_to(_pad_to(tm, 0, 1), 1, n_pad_match)  # pod pad
+        tl = _pad_to(tl, 2, n_pad_allow)
+        # target-axis guard: append w zero rows (zero targets match and
+        # allow nothing, so an empty tile's window reads only zeros)
+        tm = jnp.pad(tm, ((0, w), (0, 0)))
+        tl = jnp.pad(tl, ((0, 0), (0, w), (0, 0)))
+        return tm, tl
+
+    tm_e, tl_e = prep(tmatch_e, tallow_e, valid, valid, bs, bd)
+    tm_i, tl_i = prep(tmatch_i, tallow_i, valid, valid, bd, bs)
+    t_e_pad = tm_e.shape[0]
+    t_i_pad = tm_i.shape[0]
+    t0_e = jnp.clip(t0_e.astype(jnp.int32), 0, t_e_pad - w)
+    t0_i = jnp.clip(t0_i.astype(jnp.int32), 0, t_i_pad - w)
+
+    # slab gathers (per-eval; cacheable with the precompute when the
+    # engine's device-resident pre-cache holds)
+    def gather_tm(tm, t0, tile, count):
+        def one(i, t0i):
+            return jax.lax.dynamic_slice(tm, (t0i, i * tile), (w, tile))
+
+        return jax.vmap(one)(jnp.arange(count), t0)  # [count, w, tile]
+
+    def gather_tl(tl, t0):
+        def one(t0i):
+            return jax.lax.dynamic_slice(
+                tl, (0, t0i, 0), (q, w, tl.shape[2])
+            )
+
+        return jax.vmap(one)(t0)  # [count, q, w, n_other]
+
+    a_e = gather_tm(tm_e, t0_e, bs, n_i)  # [n_i, w, bs]
+    a_i = gather_tm(tm_i, t0_i, bd, n_j)  # [n_j, w, bd]
+    b_e = jnp.moveaxis(gather_tl(tl_e, t0_e), 1, 0)  # [q, n_i, w, nd_pad]
+    b_i = jnp.moveaxis(gather_tl(tl_i, t0_i), 1, 0)  # [q, n_j, w, ns_pad]
+
+    pe = (
+        ((~has_e) & (jnp.arange(n) < n_pods)).astype(od)[None, :]
+    )  # [1, N]
+    pi = ((~has_i) & (jnp.arange(n) < n_pods)).astype(od)[None, :]
+    vrow = valid[None, :]
+    pe = _pad_to(pe, 1, bs)
+    vs = _pad_to(vrow, 1, bs)
+    pi_d = _pad_to(pi, 1, bd)
+    vd = _pad_to(vrow, 1, bd)
+
+    counts = pl.pallas_call(
+        _make_verdict_counts_kernel_slab(),
+        grid=(q, n_i, n_j),
+        in_specs=[
+            pl.BlockSpec((1, w, bs), lambda q, i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, w, bd), lambda q, i, j: (q, i, 0, j)),
+            pl.BlockSpec((1, 1, w, bs), lambda q, i, j: (q, j, 0, i)),
+            pl.BlockSpec((1, w, bd), lambda q, i, j: (j, 0, 0)),
+            pl.BlockSpec((1, bs), lambda q, i, j: (0, i)),
+            pl.BlockSpec((1, bd), lambda q, i, j: (0, j)),
+            pl.BlockSpec((1, bd), lambda q, i, j: (0, j)),
+            pl.BlockSpec((1, bs), lambda q, i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, n_i, 128), lambda q, i, j: (q, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((1, 128), jnp.int32)],
+        out_shape=jax.ShapeDtypeStruct((q, n_i, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * q * ns_pad * nd_pad * 2 * w,
+            bytes_accessed=q * n_i * n_j * w * (bs + bd),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(a_e, b_e, b_i, a_i, pe, vd, pi_d, vs)
+    return counts[:, :, :3]
+
+
 def sum_partials(partials, q: int, n_pods: int) -> Dict[str, int]:
     """Host-side int64 reduction of [Q, n_tiles, 3] partials into the
     counts dict — the ONE place that knows the lane order (ingress,

@@ -79,6 +79,10 @@ def test_generate_resume_skips_journaled(tmp_path, capsys):
     args = [
         "generate",
         "--mock",
+        # perfect CNI so the cases PASS: generate now exits nonzero on
+        # failing cases, and the plain mock's always-succeed exec makes
+        # deny-case comparisons fail by design (mockcni docstring)
+        "--perfect-cni",
         "--engine",
         "oracle",
         "--max-cases",

@@ -372,6 +372,140 @@ class TestPallasCounts:
         }
         assert got["int8"] == got["bf16"]
 
+    def _slab_case(self, policy, pods, namespaces, bs, bd, w, n_pods=None):
+        """Run the slab kernel (interpret) on an engine's precompute and
+        pin its counts against the oracle-checked full grids."""
+        import numpy as np
+
+        from cyclonus_tpu.engine.pallas_kernel import (
+            slab_windows,
+            sum_partials,
+            verdict_counts_pallas_slab,
+        )
+        from cyclonus_tpu.engine.tiled import _precompute_jit
+
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        n = len(pods) if n_pods is None else n_pods
+        pre = _precompute_jit(engine._tensors_with_cases(CASES))
+        e, ig = pre["egress"], pre["ingress"]
+        n_b = engine._tensors["pod_ns_id"].shape[0]
+        valid = np.arange(n_b) < n
+        tm_e = np.asarray(e["tmatch"]) & valid[None, :]
+        tm_i = np.asarray(ig["tmatch"]) & valid[None, :]
+        t0_e, ok_e = slab_windows(tm_e, bs, w)
+        t0_i, ok_i = slab_windows(tm_i, bd, w)
+        assert ok_e and ok_i, "fixture must be slab-eligible"
+        partials = verdict_counts_pallas_slab(
+            e["tmatch"], e["has_target"], e["tallow_bf"],
+            ig["tmatch"], ig["has_target"], ig["tallow_bf"],
+            t0_e, t0_i, n,
+            interpret=True, bs=bs, bd=bd, w=w,
+        )
+        got = sum_partials(partials, len(CASES), 0)
+        ing, egr, comb = full_grids(engine, CASES)
+        sel = [s for s in range(min(n, len(pods)))]
+        q = len(CASES)
+        ix = np.ix_(range(q), sel, sel)
+        assert got["ingress"] == int(ing[ix].sum())
+        assert got["egress"] == int(egr[ix].sum())
+        assert got["combined"] == int(comb[ix].sum())
+
+    @pytest.mark.parametrize("seed", [30, 31, 32])
+    def test_slab_counts_match_kernel(self, seed):
+        """Per-tile target-slab kernel parity on fuzzed problems: tiny
+        tiles force multiple slabs, windows land mid-axis."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=9)
+        self._slab_case(policy, pods, namespaces, bs=8, bd=4, w=8)
+
+    def test_slab_validity_prefix(self):
+        """Validity cut below the real pod count: trailing pods must
+        contribute nothing on either axis (epilogue OR-terms included)."""
+        policy, pods, namespaces = fuzz_problem(33, n_extra_pods=10)
+        self._slab_case(policy, pods, namespaces, bs=8, bd=8, w=8, n_pods=len(pods) - 3)
+
+    def test_slab_multi_namespace_sorted(self):
+        """An ns-SORTED multi-namespace cluster — the production regime:
+        narrow per-tile windows over a longer target axis, windows
+        differing per tile, plus the bs != bd asymmetric layout."""
+        import random
+
+        import bench as bench_mod
+        from cyclonus_tpu.matcher import build_network_policies
+
+        rng = random.Random(77)
+        pods, namespaces, policies = bench_mod.build_synthetic(2000, 100, rng)
+        pods = sorted(pods, key=lambda p: p[0])  # ns-sort, like the packed path
+        policy = build_network_policies(True, policies)
+        self._slab_case(policy, pods, namespaces, bs=256, bd=128, w=64)
+
+    def test_slab_api_path(self, monkeypatch):
+        """CYCLONUS_PALLAS_SLAB=1 routes the packed counts path through
+        the slab kernel (tiny tile overrides so a fuzz cluster spans
+        multiple tiles), identical counts on cold, split/pre-cache, and
+        cached evaluations; an ineligible width gate falls back to the
+        chunked kernels with counts unchanged."""
+        import cyclonus_tpu.engine.pallas_kernel as pk
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        monkeypatch.setattr(pk, "SLAB_W", 8)
+        policy, pods, namespaces = fuzz_problem(34, n_extra_pods=10)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        got = engine.evaluate_grid_counts(CASES, backend="pallas")
+        assert isinstance(engine._slab_plan_state, dict)  # plan engaged
+        assert got == want
+        # 2nd/3rd evaluations take the split + pre-cache paths
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+
+        # deterministic width-gate fallback: two same-namespace targets
+        # that both match pods occupy two rows of one tile's window, so
+        # W=1 is ALWAYS ineligible — the plan must come back None and
+        # the chunked kernels must produce identical counts
+        from cyclonus_tpu.kube.netpol import LabelSelector
+        from cyclonus_tpu.matcher import build_network_policies
+
+        from test_engine_parity import default_cluster, mkpol
+
+        monkeypatch.setattr(pk, "SLAB_W", 1)
+        d_pods, d_ns = default_cluster()
+        policy2 = build_network_policies(
+            True,
+            [
+                mkpol("p1", "x", LabelSelector.make(match_labels={"pod": "a"}),
+                      ["Ingress"], ingress=[]),
+                mkpol("p2", "x", LabelSelector.make(match_labels={"pod": "b"}),
+                      ["Ingress"], ingress=[]),
+            ],
+        )
+        engine2 = TpuPolicyEngine(policy2, d_pods, d_ns)
+        want2 = engine2.evaluate_grid_counts(CASES, backend="xla")
+        assert engine2.evaluate_grid_counts(CASES, backend="pallas") == want2
+        assert engine2._slab_plan_state is None  # gate rejected W=1
+
+    def test_slab_windows_eligibility(self):
+        """slab_windows: window starts and the ineligibility verdict for
+        scattered (non-local) target structure."""
+        import numpy as np
+
+        from cyclonus_tpu.engine.pallas_kernel import slab_windows
+
+        tm = np.zeros((40, 8), dtype=bool)
+        tm[3, 0] = tm[5, 1] = True  # tile 0 (cols 0-3): rows 3..5
+        tm[20, 4] = tm[24, 7] = True  # tile 1: rows 20..24
+        t0, ok = slab_windows(tm, tile=4, w=8)
+        assert ok
+        assert list(t0) == [3, 20]
+        # scatter one tile's matches past the window
+        tm[35, 2] = True  # tile 0 now spans 3..35 > 8
+        _t0, ok = slab_windows(tm, tile=4, w=8)
+        assert not ok
+        # empty tmatch: trivially eligible
+        t0, ok = slab_windows(np.zeros((0, 8), dtype=bool), tile=4, w=8)
+        assert ok
+
     def test_selector_match_np_twin(self):
         """The numpy selector evaluator that drives dead-target compaction
         must agree with the device kernel op for op — fuzzed over random
