@@ -1,0 +1,94 @@
+"""Regression tests for bench.py's bounded-time failure paths (VERDICT
+r3: a wedged TPU tunnel turned the driver's bench into rc=124 with no
+output; every failure mode must now print ONE parseable JSON line with
+an "error" field and per-phase wall-clock history)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(env_extra, timeout=120):
+    env = dict(os.environ)
+    # pin CPU inside the subprocess: the env var alone is overridden by
+    # the axon sitecustomize on TPU machines (tests/conftest.py docstring)
+    env.update(env_extra)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import bench; bench.main()"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+
+
+def last_json_line(stdout):
+    lines = [l for l in stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line in output: {stdout[-500:]}"
+    return json.loads(lines[-1])
+
+
+class TestBenchGuards:
+    def test_watchdog_emits_error_json(self):
+        proc = run_bench(
+            {
+                "BENCH_DEADLINE_S": "2",
+                "BENCH_PODS": "30000",
+                "BENCH_POLICIES": "3000",
+            }
+        )
+        assert proc.returncode == 2
+        out = last_json_line(proc.stdout)
+        assert "watchdog" in out["error"]
+        assert out["value"] == 0
+        assert out["vs_baseline"] == 0.0
+        phases = [p[0] for p in out["detail"]["phase_history_s"]]
+        assert "startup" in phases  # history present and labeled
+
+    def test_crash_emits_error_json_then_raises(self):
+        # an invalid counts backend crashes inside _bench: the JSON error
+        # line must still be printed before the traceback propagates
+        proc = run_bench(
+            {
+                "BENCH_COUNTS_BACKEND": "not-a-backend",
+                "BENCH_PODS": "64",
+                "BENCH_POLICIES": "8",
+                "BENCH_DEADLINE_S": "0",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+            }
+        )
+        assert proc.returncode != 0
+        out = last_json_line(proc.stdout)
+        assert "error" in out
+        assert "not-a-backend" in out["error"]
+
+    def test_success_line_parses_with_detail_blocks(self):
+        proc = run_bench(
+            {
+                "BENCH_PODS": "256",
+                "BENCH_POLICIES": "20",
+                "BENCH_SAMPLE": "3",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+                "BENCH_COUNTS_BACKEND": "xla",
+            },
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-500:]
+        out = last_json_line(proc.stdout)
+        assert "error" not in out
+        assert out["unit"] == "cells/sec"
+        assert out["value"] > 0
+        detail = out["detail"]
+        assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
+        # roofline only reports for the pallas backend
+        assert detail["roofline"] is None

@@ -115,8 +115,42 @@ def main() -> int:
                 if thin / full < 0.6
                 else "step-bound (cut grid steps, not depth)",
             }
+        ),
+        flush=True,
+    )
+
+    # the actual candidate: per-tile slab kernel, measured via the full
+    # engine path (CYCLONUS_PALLAS_SLAB=1) so gather overhead is included.
+    # The parity reference MUST be pinned before the env flips — the
+    # first engine's slab plan is still unset, and a later counts call
+    # would engage the slab path there too, making the check slab-vs-slab.
+    os.environ["CYCLONUS_PALLAS_SLAB"] = "0"
+    want = engine.evaluate_grid_counts(cases, backend="pallas")
+    os.environ["CYCLONUS_PALLAS_SLAB"] = "1"
+    slab_engine = TpuPolicyEngine(policy, pods, namespaces)
+    counts = slab_engine.evaluate_grid_counts(cases, backend="pallas")
+    if slab_engine._slab_plan_state is None:
+        print(json.dumps({"case": "slab", "skipped": "plan ineligible"}))
+        return 0
+    times = []
+    for _ in range(5):
+        t0 = time.time()
+        counts = slab_engine.evaluate_grid_counts(cases, backend="pallas")
+        times.append(time.time() - t0)
+    print(
+        json.dumps(
+            {
+                "case": "slab-engine-path",
+                "eval_s": round(min(times), 4),
+                "reps": [round(t, 4) for t in times],
+                "speedup_vs_full": round(full / min(times), 2),
+                "counts_match_default": counts == want,
+            }
         )
     )
+    if counts != want:
+        print(json.dumps({"error": "SLAB COUNTS MISMATCH", "slab": counts, "want": want}))
+        return 1
     return 0
 
 
