@@ -18,8 +18,11 @@ size), BENCH_TILED (default 1: tiled counts mode, scales past HBM;
 0 = full-grid tables mode, needs BENCH_PODS <~ 25000 on one chip),
 BENCH_COUNTS_BACKEND (pallas | xla | sharded — mesh-parallel tile loop),
 BENCH_BLOCK (xla tile height), BENCH_SHARDED=1 (full-grid mode over a
-device mesh), BENCH_DEADLINE_S (global watchdog, default 540, 0=off),
-BENCH_INIT_DEADLINE_S (backend-attach bound, default 150, 0=off).
+device mesh), BENCH_DEADLINE_S (total watchdog backstop, default 1500,
+0=off), BENCH_STALL_S (per-phase stall bound, default 300 — trips fast
+on a wedged tunnel/compile; set 0 for huge cold one-phase compiles like
+the 2M envelope), BENCH_INIT_DEADLINE_S (backend-attach bound, default
+150, 0=off).
 
 On any failure — watchdog expiry, backend init timeout/error, or crash —
 the bench still prints one parseable JSON line with an "error" field and
@@ -73,18 +76,38 @@ def _error_json(msg: str) -> str:
     )
 
 
-def _start_watchdog(done: "threading.Event", deadline_s: float):
+def _start_watchdog(done: "threading.Event", deadline_s: float, stall_s: float):
+    """Two triggers: a PER-PHASE stall bound (stall_s — a healthy bench
+    advances phases every few seconds to a few minutes, so 300s inside
+    one phase means a wedged tunnel or the remote-compile pathology) and
+    a generous total backstop (deadline_s).  The stall bound is what
+    fires fast on the round-3 failure mode; the backstop is deliberately
+    high so a legitimately cold compile cache (6 parity compiles + the
+    main program) is never killed by its own guard."""
     import threading
 
+    t_start = time.time()
+    active = [b / 4 for b in (deadline_s, stall_s) if b > 0]
+    poll = max(0.25, min([5.0] + active))
+
     def run():
-        if not done.wait(deadline_s):
-            print(
-                _error_json(
+        while not done.wait(poll):
+            now = time.time()
+            phase_age = now - _WD["t0"]
+            total = now - t_start
+            if stall_s > 0 and phase_age > stall_s:
+                msg = (
+                    f"watchdog: stalled {phase_age:.0f}s in phase "
+                    f"'{_WD['phase']}' (BENCH_STALL_S={stall_s:g})"
+                )
+            elif deadline_s > 0 and total > deadline_s:
+                msg = (
                     f"watchdog: exceeded BENCH_DEADLINE_S={deadline_s:g}s "
                     f"in phase '{_WD['phase']}'"
-                ),
-                flush=True,
-            )
+                )
+            else:
+                continue
+            print(_error_json(msg), flush=True)
             os._exit(2)
 
     t = threading.Thread(target=run, daemon=True)
@@ -290,6 +313,7 @@ def run_compiled_parity(rng):
             )
         }
         try:
+            _enter_phase(f"compiled_parity:{pods_n}x{pols_n}:{dtype}")
             os.environ["CYCLONUS_COMPACT"] = "1" if compact else "0"
             os.environ["CYCLONUS_PALLAS_DTYPE"] = dtype
             os.environ["CYCLONUS_PALLAS_SLAB"] = "1" if slab else "0"
@@ -405,6 +429,7 @@ def mesh_scaling(pods, namespaces, policies, cases) -> dict:
     for n_dev in (1, 2, 4, 8):
         if len(cpu) < n_dev:
             break
+        _enter_phase(f"mesh_scaling:{n_dev}dev")
         mesh = Mesh(np.array(cpu[:n_dev]), ("x",))
         for name, fn in (
             (
@@ -460,9 +485,11 @@ def main():
         ).strip()
 
     done = threading.Event()
-    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "540"))
-    if deadline_s > 0:
-        _start_watchdog(done, deadline_s)
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+    stall_s = float(os.environ.get("BENCH_STALL_S", "300"))
+    # the two bounds are independent knobs: either alone arms the watchdog
+    if deadline_s > 0 or stall_s > 0:
+        _start_watchdog(done, deadline_s, stall_s)
     try:
         rc = _bench(done)
     except SystemExit:

@@ -53,6 +53,24 @@ class TestBenchGuards:
         phases = [p[0] for p in out["detail"]["phase_history_s"]]
         assert "startup" in phases  # history present and labeled
 
+    def test_stall_bound_fires_inside_one_phase(self):
+        """The per-phase stall trigger: total deadline generous, but a
+        phase that stops advancing (here: a CPU warmup that takes >2s)
+        trips BENCH_STALL_S with the phase named in the error."""
+        proc = run_bench(
+            {
+                "BENCH_STALL_S": "2",
+                "BENCH_DEADLINE_S": "600",
+                "BENCH_PODS": "20000",
+                "BENCH_POLICIES": "2000",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+            }
+        )
+        assert proc.returncode == 2
+        out = last_json_line(proc.stdout)
+        assert "stalled" in out["error"]
+
     def test_crash_emits_error_json_then_raises(self):
         # an invalid counts backend crashes inside _bench: the JSON error
         # line must still be printed before the traceback propagates
