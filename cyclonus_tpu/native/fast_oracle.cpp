@@ -311,43 +311,69 @@ extern "C" int cyclonus_evaluate_grid(const int32_t* buf, int64_t buf_len,
           }
     });
 
-    // verdict rows: for each target-side pod a, peer-side pod b, case q
+    // verdict rows: for each target-side pod a, OR its targets' tallow
+    // rows ONCE into a contiguous [N][Q] scratch, then scatter per case.
+    // The naive form (per-(b, q) loop over the pod's targets with
+    // strided tallow lookups) was ~3x slower: pods match 0-2 targets,
+    // so the verdict is one memcpy plus at most one vectorizable OR
+    // pass over contiguous rows.
     uint8_t* out = is_ingress ? out_ingress : out_egress;
     parallel_for(N, [&](int32_t lo, int32_t hi) {
       std::vector<int32_t> my_targets;
+      std::vector<uint8_t> row((size_t)N * Q);
       for (int32_t a = lo; a < hi; ++a) {
+        // ingress rows are indexed [q][dst=a][src=b]; egress
+        // [q][src=a][dst=b]
+        if (!has_target[a]) {
+          // no matching target => allow (policy.go:158-160); skips the
+          // O(T) target scan for the common unselected pod
+          for (int32_t q = 0; q < Q; ++q)
+            std::memset(out + (size_t)q * N * N + (size_t)a * N, 1, N);
+          continue;
+        }
         my_targets.clear();
         for (int32_t t = 0; t < d.T; ++t)
           if (tmatch[(size_t)t * N + a]) my_targets.push_back(t);
-        for (int32_t b = 0; b < N; ++b)
-          for (int32_t q = 0; q < Q; ++q) {
-            uint8_t allowed;
-            if (my_targets.empty()) {
-              allowed = 1;  // no matching target => allow (policy.go:158-160)
-            } else {
-              allowed = 0;
-              for (int32_t t : my_targets)
-                if (tallow[((size_t)t * N + b) * Q + q]) {
-                  allowed = 1;
-                  break;
-                }
-            }
-            // ingress rows are indexed [q][dst=a][src=b]; egress
-            // [q][src=a][dst=b]
-            out[(size_t)q * N * N + (size_t)a * N + b] = allowed;
-          }
+        std::memcpy(row.data(), &tallow[(size_t)my_targets[0] * N * Q],
+                    (size_t)N * Q);
+        for (size_t ti = 1; ti < my_targets.size(); ++ti) {
+          const uint8_t* src = &tallow[(size_t)my_targets[ti] * N * Q];
+          for (size_t i = 0; i < (size_t)N * Q; ++i) row[i] |= src[i];
+        }
+        for (int32_t q = 0; q < Q; ++q) {
+          uint8_t* o = out + (size_t)q * N * N + (size_t)a * N;
+          const uint8_t* rp = row.data() + q;
+          for (int32_t b = 0; b < N; ++b) o[b] = rp[(size_t)b * Q] != 0;
+        }
       }
     });
   }
 
-  // combined[q][s][d] = egress[q][s][d] AND ingress[q][d][s]
-  parallel_for(N, [&](int32_t lo, int32_t hi) {
-    for (int32_t s = lo; s < hi; ++s)
-      for (int32_t q = 0; q < Q; ++q)
-        for (int32_t dd = 0; dd < N; ++dd)
-          out_combined[(size_t)q * N * N + (size_t)s * N + dd] =
-              out_egress[(size_t)q * N * N + (size_t)s * N + dd] &
-              out_ingress[(size_t)q * N * N + (size_t)dd * N + s];
+  // combined[q][s][d] = egress[q][s][d] AND ingress[q][d][s].  The
+  // ingress operand is a transpose: walk it in 64x64 tiles so both
+  // operands stay cache-resident (the naive row-major walk strides the
+  // ingress reads by N and thrashes at tens of thousands of pods).
+  constexpr int32_t TB = 64;
+  const int32_t n_tiles = (N + TB - 1) / TB;
+  // work items = (s-tile, q) pairs: tile-granular for the transpose's
+  // cache locality without starving cores at small N the way pure
+  // s-tile parallelism would
+  parallel_for(n_tiles * Q, [&](int32_t lo, int32_t hi) {
+    for (int32_t item = lo; item < hi; ++item) {
+      const int32_t bi = item / Q;
+      const int32_t q = item % Q;
+      const int32_t s0 = bi * TB;
+      const int32_t s1 = s0 + TB < N ? s0 + TB : N;
+      const size_t base = (size_t)q * N * N;
+      for (int32_t d0 = 0; d0 < N; d0 += TB) {
+        const int32_t d1 = d0 + TB < N ? d0 + TB : N;
+        for (int32_t s = s0; s < s1; ++s)
+          for (int32_t dd = d0; dd < d1; ++dd)
+            out_combined[base + (size_t)s * N + dd] =
+                out_egress[base + (size_t)s * N + dd] &
+                out_ingress[base + (size_t)dd * N + s];
+      }
+    }
   });
   return 0;
 }
