@@ -625,6 +625,9 @@ class TpuPolicyEngine:
         self._pod_perm_dev = None  # ns-order pod permutation (counts path)
         self._pod_perm_host = None
         self._slab_plan_state = "unset"  # -> None | {direction: t0 dev array}
+        # None = not yet tuned (auto mode times both at the first
+        # steady-state call); True/False = slab kernel chosen/rejected
+        self._slab_choice = None
         self._counts_packed_jit = None
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
@@ -838,18 +841,34 @@ class TpuPolicyEngine:
         Host-side eligibility with the SAME reduction the kernel's
         safety rests on: per direction, every pod tile's matching
         targets (on the ns-sorted axis = perm order) must fit one
-        SLAB_W window (pallas_kernel.slab_windows).  Gated off unless
-        CYCLONUS_PALLAS_SLAB=1 — the slab path's win is the contraction
-        depth cut (2*SLAB_W vs kt_e+kt_i) and only exists on hardware;
-        flip the default once driver-measured — and the cluster spans
-        at least two src tiles (below that the single-chunk kernel is
-        already minimal).  The numpy tmatch twin here is the same
-        formula as kernel.direction_precompute, O(T*N) once per engine."""
+        SLAB_W window (pallas_kernel.slab_windows).  CYCLONUS_PALLAS_SLAB
+        modes: "auto" (default) plans on TPU and lets the first
+        steady-state call TIME both programs and keep the winner
+        (_autotune_slab) — the depth-cut win only exists on hardware and
+        interpret-mode timing is meaningless, so auto never engages off
+        TPU; "1" forces the slab kernel (how CPU tests and the bench
+        parity case exercise it); "0" disables.  Also requires the
+        cluster to span at least two src tiles (below that the
+        single-chunk kernel is already minimal) and the materialized
+        slabs to fit the byte budget.  The numpy tmatch twin here is the
+        same formula as kernel.direction_precompute, O(T*N) once per
+        engine."""
         import os
 
         from .pallas_kernel import SLAB_BD, SLAB_BS, SLAB_W, slab_windows
 
-        if os.environ.get("CYCLONUS_PALLAS_SLAB", "0") != "1":
+        mode = os.environ.get("CYCLONUS_PALLAS_SLAB", "auto").lower()
+        if mode == "auto":
+            import jax
+
+            if jax.default_backend() != "tpu":
+                return None
+            if not _pre_cache_enabled():
+                # the autotune point IS the first steady-state (pinned
+                # precompute) call; with the pre-cache off it would
+                # never fire, so don't pay the plan for a dead path
+                return None
+        elif mode != "1":
             return None
         n_b = int(self._tensors["pod_ns_id"].shape[0])
         if n_b < 2 * SLAB_BS:
@@ -892,7 +911,49 @@ class TpuPolicyEngine:
             if not ok:
                 return None
             plan[direction] = jax.device_put(t0)
+        if mode == "1":
+            # forced mode skips the autotune; set the choice only now
+            # that the plan is actually accepted (a stale True with no
+            # plan would break the invariant autotune readers rely on)
+            self._slab_choice = True
         return plan
+
+    def _autotune_slab(self, n32, slab_args):
+        """Steady-state kernel autotune: time the default and the slab
+        counts programs from the SAME pinned precompute (min of 2 each;
+        a value readback is the barrier — block_until_ready can return
+        optimistically over a tunneled device) and keep the winner for
+        the rest of the engine's life.  The slab program must beat the
+        default by >10% to be chosen: tunneled timing noise is real and
+        the default is the conservatively proven path.  Returns the
+        winner's partials for the call that paid for the tuning."""
+        import logging
+        import time as _time
+
+        pre = self._pre_cache[1]
+
+        def timed(args):
+            out = self._counts_from_pre_jit(pre, n32, *args)
+            np.asarray(out)  # compile + first execution outside the timing
+            best = None
+            for _ in range(2):
+                t0 = _time.perf_counter()
+                out = self._counts_from_pre_jit(pre, n32, *args)
+                np.asarray(out)
+                dt = _time.perf_counter() - t0
+                best = dt if best is None or dt < best else best
+            return best, out
+
+        t_default, out_default = timed((None, None))
+        t_slab, out_slab = timed(slab_args)
+        self._slab_choice = bool(t_slab < 0.9 * t_default)
+        logging.getLogger(__name__).info(
+            "slab autotune: default %.4fs, slab %.4fs -> %s",
+            t_default,
+            t_slab,
+            "slab" if self._slab_choice else "default",
+        )
+        return out_slab if self._slab_choice else out_default
 
     def _build_counts_jits(self) -> None:
         """Build the three counts programs once per engine: the fused
@@ -1000,8 +1061,13 @@ class TpuPolicyEngine:
             with phase("engine.slab_plan"):
                 self._slab_plan_state = self._slab_plan(self._pod_perm_host)
         slab = self._slab_plan_state
+        # until an auto plan is tuned-in, every path runs the default
+        # kernel; a forced plan (CYCLONUS_PALLAS_SLAB=1) sets the choice
+        # to True at plan time
         slab_args = (
-            (slab["egress"], slab["ingress"]) if slab else (None, None)
+            (slab["egress"], slab["ingress"])
+            if slab and self._slab_choice is True
+            else (None, None)
         )
         if self._counts_packed_jit is None:
             self._build_counts_jits()
@@ -1012,10 +1078,19 @@ class TpuPolicyEngine:
         if self._pre_cache is not None and self._pre_cache[0] == key:
             # steady state: only the pallas counts kernel runs
             self._pre_cache_misses = 0
-            with phase("engine.dispatch"):
-                partials = self._counts_from_pre_jit(
-                    self._pre_cache[1], np.int32(n), *slab_args
-                )
+            if slab and self._slab_choice is None:
+                # autotune at the first steady-state call: both programs
+                # run from the SAME pinned precompute, so this times
+                # exactly what every later call will execute
+                with phase("engine.autotune"):
+                    partials = self._autotune_slab(
+                        np.int32(n), (slab["egress"], slab["ingress"])
+                    )
+            else:
+                with phase("engine.dispatch"):
+                    partials = self._counts_from_pre_jit(
+                        self._pre_cache[1], np.int32(n), *slab_args
+                    )
         elif (
             self._last_counts_key == key
             and key != self._pre_cache_declined

@@ -485,6 +485,58 @@ class TestPallasCounts:
         assert engine2.evaluate_grid_counts(CASES, backend="pallas") == want2
         assert engine2._slab_plan_state is None  # gate rejected W=1
 
+    def test_slab_autotune_mechanics(self, monkeypatch):
+        """_autotune_slab times both steady-state programs from the
+        pinned precompute, records a boolean choice, and returns
+        partials identical to either path (the perf decision itself is
+        TPU-side; this pins the mechanics)."""
+        import numpy as np
+
+        import cyclonus_tpu.engine.pallas_kernel as pk
+        from cyclonus_tpu.engine.pallas_kernel import sum_partials
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        monkeypatch.setattr(pk, "SLAB_W", 8)
+        policy, pods, namespaces = fuzz_problem(35, n_extra_pods=10)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        for _ in range(3):  # reach the pinned-precompute steady state
+            assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._pre_cache is not None
+        engine._slab_choice = None
+        slab = engine._slab_plan_state
+        partials = engine._autotune_slab(
+            np.int32(len(pods)), (slab["egress"], slab["ingress"])
+        )
+        assert engine._slab_choice in (True, False)
+        got = sum_partials(np.asarray(partials), len(CASES), len(pods))
+        for k in ("ingress", "egress", "combined"):
+            assert got[k] == want[k]
+        # later calls run the recorded winner
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+
+    def test_slab_auto_mode_needs_tpu(self, monkeypatch):
+        """The default 'auto' mode never engages off TPU (interpret-mode
+        timing is meaningless): no plan, default kernels, counts
+        unchanged."""
+        import jax
+
+        import cyclonus_tpu.engine.pallas_kernel as pk
+
+        if jax.default_backend() == "tpu":
+            pytest.skip("off-TPU behavior; suite running on real TPU")
+        monkeypatch.delenv("CYCLONUS_PALLAS_SLAB", raising=False)
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        policy, pods, namespaces = fuzz_problem(36, n_extra_pods=8)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._slab_plan_state is None
+        assert engine._slab_choice is None
+
     def test_slab_windows_eligibility(self):
         """slab_windows: window starts and the ineligibility verdict for
         scattered (non-local) target structure."""
