@@ -40,10 +40,18 @@ def setup_generate(sub) -> None:
     )
     cmd.add_argument("--context", default="", help="kube context")
     cmd.add_argument(
-        "--server-namespace", action="append", default=None, help="namespaces (default x,y,z)"
+        "--server-namespace",
+        "--namespace",  # the reference's generate spells it --namespace
+        action="append",
+        default=None,
+        help="namespaces (default x,y,z)",
     )
     cmd.add_argument(
-        "--server-pod", action="append", default=None, help="pod names (default a,b,c)"
+        "--server-pod",
+        "--pod",  # reference alias (generate.go)
+        action="append",
+        default=None,
+        help="pod names (default a,b,c)",
     )
     cmd.add_argument(
         "--server-port", action="append", type=int, default=None, help="ports (default 80,81)"

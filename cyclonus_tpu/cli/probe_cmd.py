@@ -34,7 +34,11 @@ def setup_probe(sub) -> None:
     )
     cmd.add_argument("--context", default="", help="kube context")
     cmd.add_argument(
-        "--server-namespace", action="append", default=None, help="namespaces (default x,y,z)"
+        "-n",
+        "--server-namespace",
+        action="append",
+        default=None,
+        help="namespaces (default x,y,z)",
     )
     cmd.add_argument(
         "--server-pod", action="append", default=None, help="pod names (default a,b,c)"
@@ -53,8 +57,21 @@ def setup_probe(sub) -> None:
         "--all-available", action="store_true",
         help="probe all available (port, protocol) server combinations",
     )
-    cmd.add_argument("--probe-port", default=None, help="port to probe (int or name)")
-    cmd.add_argument("--probe-protocol", default="TCP", help="protocol to probe")
+    cmd.add_argument(
+        "--probe-port",
+        "--port",  # reference alias (probe.go --port, repeatable)
+        action="append",
+        default=None,
+        help="port(s) to probe, numbered or named; repeatable — one "
+        "probe per (port, protocol) combination",
+    )
+    cmd.add_argument(
+        "--probe-protocol",
+        "--protocol",  # reference alias (probe.go --protocol, repeatable)
+        action="append",
+        default=None,
+        help="protocol(s) to probe (default TCP); repeatable",
+    )
     cmd.add_argument(
         "--probe-mode", default=PROBE_MODE_SERVICE_NAME, choices=[str(m) for m in ALL_PROBE_MODES]
     )
@@ -104,35 +121,72 @@ def run_probe(args) -> int:
 
         kubernetes.exec_verdict_fn = PolicyAwareMockExec(kubernetes)
 
-    actions = [read_network_policies(namespaces)]
+    read = read_network_policies(namespaces)  # idempotent, re-run per case
+    creates = []
     if args.policy_path:
         for policy in load_policies_from_path(args.policy_path):
-            actions.append(create_policy(policy))
+            creates.append(create_policy(policy))
 
-    if args.all_available or args.probe_port is None:
-        probe_config = ProbeConfig.all_available_config(ProbeMode(args.probe_mode))
+    mode = ProbeMode(args.probe_mode)
+    if args.all_available or (
+        args.probe_port is None and args.probe_protocol is None
+    ):
+        probe_configs = [
+            ("all available one-off probe", ProbeConfig.all_available_config(mode))
+        ]
     else:
-        port_str = args.probe_port
-        port = IntOrString(int(port_str)) if port_str.isdigit() else IntOrString(port_str)
-        probe_config = ProbeConfig.port_protocol_config(
-            port, args.probe_protocol.upper(), ProbeMode(args.probe_mode)
+        # one probe per (port, protocol) combination, like the
+        # reference's loop (probe.go:123-130); a protocol without a port
+        # probes the reference's default port list (["80"])
+        probe_ports = args.probe_port or ["80"]
+        probe_protocols = args.probe_protocol or ["TCP"]
+        probe_configs = []
+        for port_str in probe_ports:
+            port = (
+                IntOrString(int(port_str))
+                if port_str.isdigit()
+                else IntOrString(port_str)
+            )
+            for proto in probe_protocols:
+                probe_configs.append(
+                    (
+                        f"one-off probe {port_str}/{proto.upper()}",
+                        ProbeConfig.port_protocol_config(
+                            port, proto.upper(), mode
+                        ),
+                    )
+                )
+
+    def make_config(wait_s):
+        return InterpreterConfig(
+            kube_probe_retries=0,
+            perturbation_wait_seconds=wait_s,
+            simulated_engine=args.engine,
+            pod_wait_timeout_seconds=args.pod_creation_timeout_seconds,
+            ignore_loopback=args.ignore_loopback,
         )
 
-    test_case = TestCase(
-        description="one-off probe",
-        tags=StringSet(),
-        steps=[TestStep(probe=probe_config, actions=actions)],
+    interpreter = Interpreter(
+        kubernetes, resources, make_config(perturbation_wait_seconds(args))
     )
-    config = InterpreterConfig(
-        kube_probe_retries=0,
-        perturbation_wait_seconds=perturbation_wait_seconds(args),
-        simulated_engine=args.engine,
-        pod_wait_timeout_seconds=args.pod_creation_timeout_seconds,
-        ignore_loopback=args.ignore_loopback,
-    )
-    interpreter = Interpreter(kubernetes, resources, config)
-    result = interpreter.execute_test_case(test_case)
+    # later cases only re-run the idempotent read (the creates applied in
+    # case 1 and would error on re-apply), so they need no settle wait
+    interpreter_settled = Interpreter(kubernetes, resources, make_config(0))
     printer = Printer(noisy=args.noisy, ignore_loopback=args.ignore_loopback)
-    printer.print_test_case_result(result)
+    for i, (description, probe_config) in enumerate(probe_configs):
+        test_case = TestCase(
+            description=description,
+            tags=StringSet(),
+            steps=[
+                TestStep(
+                    probe=probe_config,
+                    actions=[read] + creates if i == 0 else [read],
+                )
+            ],
+        )
+        result = (interpreter if i == 0 else interpreter_settled).execute_test_case(
+            test_case
+        )
+        printer.print_test_case_result(result)
     close_cluster(kubernetes)
     return 0

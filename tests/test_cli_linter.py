@@ -256,6 +256,27 @@ class TestCLI:
         assert "passed" in proc.stdout
         assert "| Tag | Result |" in proc.stdout
 
+    def test_probe_multi_port_protocol(self):
+        """Reference-parity probe flags (probe.go:123-130): repeatable
+        --port/--protocol run one probe per combination."""
+        proc = run_cli(
+            "probe",
+            "--mock",
+            "--perfect-cni",
+            "--port",
+            "80",
+            "--port",
+            "81",
+            "--protocol",
+            "tcp",
+            "--protocol",
+            "udp",
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr
+        for combo in ("80/TCP", "80/UDP", "81/TCP", "81/UDP"):
+            assert f"one-off probe {combo}" in proc.stdout
+
     def test_probe_mock(self):
         proc = run_cli(
             "probe",
