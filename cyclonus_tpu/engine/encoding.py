@@ -154,9 +154,31 @@ def _encode_label_rows(
     identical to the scalar form (first appearance in row-major sorted
     order), so selector tables encoded earlier against the same vocab
     stay consistent."""
+    n = len(label_maps)
+    # Distinct-map dedup: clusters repeat a small set of label maps
+    # across huge pod counts (replicas share a template), so encode each
+    # DISTINCT map once and scatter by row index.  The cache key is the
+    # map's insertion-order items — equal maps built in different orders
+    # just dedup less, never wrongly merge.  Vocab id assignment order
+    # is unchanged: a repeated map introduces no new pair on later
+    # appearances, so first-appearance order over distinct maps equals
+    # first-appearance order over all rows.
+    row_of = np.empty(max(n, 1), dtype=np.int32)
+    distinct_index: Dict[tuple, int] = {}
+    label_maps_d: List[Dict[str, str]] = []
+    for i, m in enumerate(label_maps):
+        cache_key = tuple(m.items())
+        rid = distinct_index.get(cache_key)
+        if rid is None:
+            rid = distinct_index[cache_key] = len(label_maps_d)
+            label_maps_d.append(m)
+        row_of[i] = rid
+    if len(label_maps_d) < n:
+        kv_d, key_d = _encode_label_rows(label_maps_d, vocab)
+        return kv_d[row_of[:n]], key_d[row_of[:n]]
+
     max_l = max((len(m) for m in label_maps), default=0)
     max_l = max(max_l, 1)
-    n = len(label_maps)
     kv = np.full((n, max_l), -1, dtype=np.int32)
     key = np.full((n, max_l), -1, dtype=np.int32)
     rows, cols, ks, vs = [], [], [], []
@@ -187,6 +209,47 @@ def _encode_label_rows(
     kv[rows, cols] = kv_ids
     key[rows, cols] = key_ids
     return kv, key
+
+
+_STRICT_IPV4_LINES = None  # compiled lazily (module import stays light)
+
+
+def _encode_pod_ips(ips: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """(pod_ip uint32 [N], pod_ip_valid bool [N]) for all pods at once.
+
+    Bulk fast path: ONE multiline regex pass over the joined IP strings
+    (the strict octet grammar — exactly what _fast_ipv4_to_uint32
+    accepts: no leading zeros, no signs/whitespace, 0-255) and one numpy
+    combine.  Any line that doesn't match breaks the count, and the
+    whole batch falls back to the per-item path — mixed/IPv6 clusters
+    keep exact semantics, all-IPv4 clusters (the big ones) skip ~4us of
+    python per pod."""
+    global _STRICT_IPV4_LINES
+    if not ips:
+        return np.zeros((0,), np.uint32), np.zeros((0,), bool)
+    if _STRICT_IPV4_LINES is None:
+        import re
+
+        octet = r"(25[0-5]|2[0-4][0-9]|1[0-9][0-9]|[1-9][0-9]|[0-9])"
+        _STRICT_IPV4_LINES = re.compile(
+            rf"(?m)^{octet}\.{octet}\.{octet}\.{octet}$"
+        )
+    if not any("\n" in ip for ip in ips):
+        matches = _STRICT_IPV4_LINES.findall("\n".join(ips))
+        if len(matches) == len(ips):
+            octets = np.array(matches, dtype=np.uint32)  # [N, 4]
+            ip_int = (
+                (octets[:, 0] << 24)
+                | (octets[:, 1] << 16)
+                | (octets[:, 2] << 8)
+                | octets[:, 3]
+            )
+            return ip_int.astype(np.uint32), np.ones(len(ips), dtype=bool)
+    ip_ints = [_fast_ipv4_to_uint32(ip) for ip in ips]
+    return (
+        np.array([i or 0 for i in ip_ints], dtype=np.uint32),
+        np.array([i is not None for i in ip_ints], dtype=bool),
+    )
 
 
 def _fast_ipv4_to_uint32(ip: str) -> Optional[int]:
@@ -246,9 +309,7 @@ def encode_cluster(
     ) if pods else np.zeros((0,), dtype=np.int32)
     pod_kv, pod_key = _encode_label_rows([p[2] for p in pods], vocab)
     ips = [p[3] for p in pods]
-    ip_ints = [_fast_ipv4_to_uint32(ip) for ip in ips]
-    pod_ip = np.array([i or 0 for i in ip_ints], dtype=np.uint32)
-    pod_ip_valid = np.array([i is not None for i in ip_ints], dtype=bool)
+    pod_ip, pod_ip_valid = _encode_pod_ips(ips)
     return ClusterEncoding(
         vocab=vocab,
         pod_keys=[f"{p[0]}/{p[1]}" for p in pods],

@@ -806,3 +806,63 @@ class TestFuzzParity:
         policy = build_network_policies(True, policies)
         cases = [PortCase(80, "serve-80-tcp", "TCP"), PortCase(81, "", "UDP")]
         assert_parity(policy, pods, namespaces, cases, sharded=True)
+
+
+class TestEncodingFastPaths:
+    """Direct pins for the vectorized encode fast paths (the suites
+    above cover them end-to-end; these pin the edge semantics)."""
+
+    def test_bulk_ip_parse_matches_scalar(self):
+        from cyclonus_tpu.engine.encoding import (
+            _encode_pod_ips,
+            _fast_ipv4_to_uint32,
+        )
+
+        all_v4 = [f"10.{i % 4}.{i % 256}.{(i * 7) % 256}" for i in range(500)]
+        all_v4 += ["0.0.0.0", "255.255.255.255", "9.9.9.9"]
+        ip, ok = _encode_pod_ips(all_v4)
+        assert ok.all()
+        for i, s in enumerate(all_v4):
+            assert int(ip[i]) == _fast_ipv4_to_uint32(s), s
+
+        # any non-strict line drops the whole batch to the per-item
+        # path, which must agree with the scalar helper exactly
+        for bad in ("01.2.3.4", "1.2.3.256", "1.2.3", "2001:db8::1", "",
+                    " 1.2.3.4", "1.2.3.4 ", "+1.2.3.4", "1.2.3.4x"):
+            mixed = ["1.2.3.4", bad, "5.6.7.8"]
+            ip, ok = _encode_pod_ips(mixed)
+            for i, s in enumerate(mixed):
+                want = _fast_ipv4_to_uint32(s)
+                assert bool(ok[i]) == (want is not None), s
+                if want is not None:
+                    assert int(ip[i]) == want, s
+
+    def test_label_rows_dedup_matches_distinct_encode(self):
+        import numpy as np
+
+        from cyclonus_tpu.engine.encoding import _Vocab, _encode_label_rows
+
+        maps = [
+            {"app": "web", "tier": "fe"},
+            {"app": "db"},
+            {"app": "web", "tier": "fe"},  # repeat -> dedup path
+            {},
+            {"tier": "fe", "app": "web"},  # same map, other insert order
+            {"app": "db"},
+        ]
+        v1 = _Vocab()
+        kv_a, key_a = _encode_label_rows(maps, v1)
+        # reference: maps[:2] are all-distinct, so this call genuinely
+        # takes the NON-dedup base path — a bug in the dedup/scatter
+        # branch cannot corrupt both sides identically
+        v2 = _Vocab()
+        kv_b, key_b = _encode_label_rows(list(maps[:2]), v2)
+        # identical rows encode identically, and vocab ids assign in
+        # first-appearance order regardless of dedup
+        assert np.array_equal(kv_a[0], kv_a[2])
+        assert np.array_equal(kv_a[0], kv_a[4])  # insertion order irrelevant
+        assert np.array_equal(kv_a[1], kv_a[5])
+        assert (kv_a[3] == -1).all()
+        assert np.array_equal(kv_a[:2], kv_b[:2])
+        assert np.array_equal(key_a[:2], key_b[:2])
+        assert v1.kv == v2.kv  # same pairs, same ids
