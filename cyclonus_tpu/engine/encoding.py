@@ -163,7 +163,7 @@ def _encode_label_rows(
     # is unchanged: a repeated map introduces no new pair on later
     # appearances, so first-appearance order over distinct maps equals
     # first-appearance order over all rows.
-    row_of = np.empty(max(n, 1), dtype=np.int32)
+    row_of = np.empty(n, dtype=np.int32)
     distinct_index: Dict[tuple, int] = {}
     label_maps_d: List[Dict[str, str]] = []
     for i, m in enumerate(label_maps):
@@ -175,7 +175,7 @@ def _encode_label_rows(
         row_of[i] = rid
     if len(label_maps_d) < n:
         kv_d, key_d = _encode_label_rows(label_maps_d, vocab)
-        return kv_d[row_of[:n]], key_d[row_of[:n]]
+        return kv_d[row_of], key_d[row_of]
 
     max_l = max((len(m) for m in label_maps), default=0)
     max_l = max(max_l, 1)

@@ -788,6 +788,28 @@ class TestFuzzParity:
         for."""
         run_fuzz_seed(seed, counts=True)
 
+    @pytest.mark.fuzz
+    @pytest.mark.parametrize("seed", range(2000, 2030))
+    def test_fuzz_slab_counts(self, seed, monkeypatch):
+        """Slab-kernel fuzz leg: randomized problems through the forced
+        per-tile-slab counts path (tiny tiles so every cluster spans
+        multiple windows) vs the xla tile loop.  A 100-seed one-off
+        sweep of this form ran clean when the kernel landed; these 30
+        keep it enforced."""
+        from test_engine_tiled import CASES, fuzz_problem
+
+        import cyclonus_tpu.engine.pallas_kernel as pk
+        from cyclonus_tpu.engine import TpuPolicyEngine
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        monkeypatch.setattr(pk, "SLAB_W", 8)
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=seed % 13)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+
     @pytest.mark.parametrize("seed", [0, 5, 9])
     def test_fuzz_sharded_matches_oracle(self, seed):
         rng = random.Random(seed + 1000)
