@@ -945,7 +945,20 @@ class TpuPolicyEngine:
             return best, out
 
         t_default, out_default = timed((None, None))
-        t_slab, out_slab = timed(slab_args)
+        try:
+            t_slab, out_slab = timed(slab_args)
+        except Exception as e:
+            # a candidate kernel that fails to compile/run REJECTS
+            # itself — it must never take down the proven default path
+            # (this autotune is the only place the slab program runs
+            # unforced, so the failure is contained here)
+            self._slab_choice = False
+            logging.getLogger(__name__).warning(
+                "slab autotune: candidate failed (%s: %s) -> default",
+                type(e).__name__,
+                e,
+            )
+            return out_default
         self._slab_choice = bool(t_slab < 0.9 * t_default)
         logging.getLogger(__name__).info(
             "slab autotune: default %.4fs, slab %.4fs -> %s",

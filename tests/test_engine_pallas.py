@@ -517,6 +517,47 @@ class TestPallasCounts:
         # later calls run the recorded winner
         assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
 
+    def test_slab_autotune_candidate_failure_rejects(self, monkeypatch):
+        """A slab program that fails to compile/run must reject ITSELF
+        in the autotune — choice False, default result returned, no
+        exception — because the autotune is where an unproven kernel
+        runs unforced and must never take down the proven path."""
+        import numpy as np
+
+        import cyclonus_tpu.engine.pallas_kernel as pk
+        from cyclonus_tpu.engine.pallas_kernel import sum_partials
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        monkeypatch.setattr(pk, "SLAB_W", 8)
+        policy, pods, namespaces = fuzz_problem(37, n_extra_pods=9)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        for _ in range(3):
+            assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._pre_cache is not None
+        engine._slab_choice = None
+        real = engine._counts_from_pre_jit
+
+        def flaky(pre, n, t0_e=None, t0_i=None):
+            if t0_e is not None:
+                raise RuntimeError("mosaic compile failure (simulated)")
+            return real(pre, n)
+
+        monkeypatch.setattr(engine, "_counts_from_pre_jit", flaky)
+        slab = engine._slab_plan_state
+        partials = engine._autotune_slab(
+            np.int32(len(pods)), (slab["egress"], slab["ingress"])
+        )
+        assert engine._slab_choice is False
+        got = sum_partials(np.asarray(partials), len(CASES), len(pods))
+        for k in ("ingress", "egress", "combined"):
+            assert got[k] == want[k]
+        # the rejection sticks: later calls run the default path through
+        # the flaky jit without touching the slab leg
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+
     def test_slab_auto_mode_needs_tpu(self, monkeypatch):
         """The default 'auto' mode never engages off TPU (interpret-mode
         timing is meaningless): no plan, default kernels, counts
