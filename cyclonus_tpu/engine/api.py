@@ -931,12 +931,15 @@ class TpuPolicyEngine:
         import time as _time
 
         pre = self._pre_cache[1]
+        cancelled = {"v": False}
 
         def timed(args):
             out = self._counts_from_pre_jit(pre, n32, *args)
             np.asarray(out)  # compile + first execution outside the timing
             best = None
             for _ in range(2):
+                if cancelled["v"]:
+                    raise RuntimeError("autotune candidate cancelled")
                 t0 = _time.perf_counter()
                 out = self._counts_from_pre_jit(pre, n32, *args)
                 np.asarray(out)
@@ -945,20 +948,34 @@ class TpuPolicyEngine:
             return best, out
 
         t_default, out_default = timed((None, None))
-        try:
-            t_slab, out_slab = timed(slab_args)
-        except Exception as e:
-            # a candidate kernel that fails to compile/run REJECTS
+        # the candidate leg is BOUNDED as well as caught: its first call
+        # compiles a brand-new program, and a wedged remote compile
+        # service (the known >=1M-pod pathology) must reject the
+        # candidate, not stall the caller into a watchdog kill.  On
+        # timeout the abandoned daemon thread finishes its IN-FLIGHT
+        # compile+execution (unavoidable) but the cancel flag stops the
+        # timing loop there, so at most one spurious slab execution
+        # competes with the caller's subsequent default-path work.
+        import os
+
+        from ..utils.bounded import run_bounded
+
+        timeout_s = float(os.environ.get("CYCLONUS_AUTOTUNE_TIMEOUT_S", "240"))
+        status, value = run_bounded(lambda: timed(slab_args), timeout_s)
+        if status != "ok":
+            cancelled["v"] = True
+            # compile/run failure or timeout: the candidate rejects
             # itself — it must never take down the proven default path
             # (this autotune is the only place the slab program runs
             # unforced, so the failure is contained here)
             self._slab_choice = False
             logging.getLogger(__name__).warning(
-                "slab autotune: candidate failed (%s: %s) -> default",
-                type(e).__name__,
-                e,
+                "slab autotune: candidate %s (%s) -> default",
+                "timed out" if status == "timeout" else "failed",
+                f"{timeout_s:g}s" if status == "timeout" else repr(value),
             )
             return out_default
+        t_slab, out_slab = value
         self._slab_choice = bool(t_slab < 0.9 * t_default)
         logging.getLogger(__name__).info(
             "slab autotune: default %.4fs, slab %.4fs -> %s",

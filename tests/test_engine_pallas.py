@@ -558,6 +558,27 @@ class TestPallasCounts:
         # the flaky jit without touching the slab leg
         assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
 
+        # a HANGING candidate (wedged remote compile) must also reject
+        # via the bounded leg, not stall the caller
+        import time as _t
+
+        def hanging(pre, n, t0_e=None, t0_i=None):
+            if t0_e is not None:
+                _t.sleep(30)
+            return real(pre, n)
+
+        monkeypatch.setattr(engine, "_counts_from_pre_jit", hanging)
+        monkeypatch.setenv("CYCLONUS_AUTOTUNE_TIMEOUT_S", "0.5")
+        engine._slab_choice = None
+        t0 = _t.time()
+        partials = engine._autotune_slab(
+            np.int32(len(pods)), (slab["egress"], slab["ingress"])
+        )
+        assert _t.time() - t0 < 10
+        assert engine._slab_choice is False
+        got = sum_partials(np.asarray(partials), len(CASES), len(pods))
+        assert got["combined"] == want["combined"]
+
     def test_slab_auto_mode_needs_tpu(self, monkeypatch):
         """The default 'auto' mode never engages off TPU (interpret-mode
         timing is meaningless): no plan, default kernels, counts
