@@ -22,4 +22,15 @@ run analyze --mode probe --engine tpu \
 
 run generate --mock --dry-run
 
+# conformance over REAL sockets, no kubernetes: pods as processes on
+# 127.x addresses, probes via the real in-pod worker (docs/LOOPBACK.md).
+# Needs Linux (the whole 127/8 block is bindable there) and root (the
+# generated cases serve ports 80/81); skipped elsewhere.
+if [ "$(uname -s)" = "Linux" ] && [ "$(id -u)" = "0" ]; then
+  run generate --loopback --include conflict --retries 0 \
+    --engine oracle --max-cases 4
+else
+  echo "(skipping loopback demo: needs Linux + root for 127/8 binds on ports 80/81)"
+fi
+
 run recipes
