@@ -100,3 +100,25 @@ def test_generate_resume_skips_journaled(tmp_path, capsys):
     assert "skipping journaled test case" in out
     entries2 = [json.loads(l) for l in open(journal) if l.strip()]
     assert len(entries2) == 2
+
+
+def test_run_bounded_three_outcomes():
+    """utils.bounded.run_bounded: the contract every bounded backend
+    touchpoint (CLI --devices, runner probe, autotune candidate) rests
+    on — ok with the value, error with the exception, timeout with
+    None, and a timeout must not block the caller."""
+    import time
+
+    from cyclonus_tpu.utils.bounded import run_bounded
+
+    assert run_bounded(lambda: 42, 5) == ("ok", 42)
+
+    status, exc = run_bounded(lambda: 1 / 0, 5)
+    assert status == "error"
+    assert isinstance(exc, ZeroDivisionError)
+
+    t0 = time.time()
+    status, value = run_bounded(lambda: time.sleep(10), 0.2)
+    assert status == "timeout"
+    assert value is None
+    assert time.time() - t0 < 5
