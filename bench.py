@@ -747,6 +747,17 @@ def _bench(done):
                         # bucketed shapes/dtypes/kernels (BENCH_PARITY=0
                         # to skip); a mismatch raises above
                         "compiled_parity": compiled_parity,
+                        # which counts kernel the engine's on-device
+                        # autotune picked (auto mode: slab vs default
+                        # timed at the first steady-state eval), with
+                        # the measured legs — None if never tuned
+                        "slab": {
+                            "plan": isinstance(
+                                engine._slab_plan_state, dict
+                            ),
+                            "choice": engine._slab_choice,
+                            "autotune": engine._slab_autotune,
+                        },
                         # analytic v5e limit for THIS eval's shapes: which
                         # of HBM / MXU(dense) / VPU-epilogue binds, and
                         # how close the measured eval is to it

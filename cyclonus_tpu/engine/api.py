@@ -628,6 +628,7 @@ class TpuPolicyEngine:
         # None = not yet tuned (auto mode times both at the first
         # steady-state call); True/False = slab kernel chosen/rejected
         self._slab_choice = None
+        self._slab_autotune = None  # {"default_s", "slab_s"} once timed
         self._counts_packed_jit = None
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
@@ -977,6 +978,10 @@ class TpuPolicyEngine:
             return out_default
         t_slab, out_slab = value
         self._slab_choice = bool(t_slab < 0.9 * t_default)
+        self._slab_autotune = {
+            "default_s": round(t_default, 4),
+            "slab_s": round(t_slab, 4),
+        }
         logging.getLogger(__name__).info(
             "slab autotune: default %.4fs, slab %.4fs -> %s",
             t_default,
