@@ -564,6 +564,12 @@ def _bench(done):
     init_thread = threading.Thread(target=_init_backend, daemon=True)
     init_thread.start()
 
+    # the slab autotune (engine api) may compile a second program inside
+    # the eval phase; keep its bound comfortably under BENCH_STALL_S so
+    # a wedged candidate compile self-rejects before the phase watchdog
+    # could kill the whole bench (typical 100k-shape compiles are
+    # 20-60s; explicit env wins)
+    os.environ.setdefault("CYCLONUS_AUTOTUNE_TIMEOUT_S", "150")
     sharded = os.environ.get("BENCH_SHARDED", "") == "1"
     # BENCH_SHARDED selects the full-grid mesh path, which the tiled
     # default would otherwise shadow
