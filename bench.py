@@ -568,8 +568,11 @@ def _bench(done):
     # the eval phase; keep its bound comfortably under BENCH_STALL_S so
     # a wedged candidate compile self-rejects before the phase watchdog
     # could kill the whole bench (typical 100k-shape compiles are
-    # 20-60s; explicit env wins)
-    os.environ.setdefault("CYCLONUS_AUTOTUNE_TIMEOUT_S", "150")
+    # 20-60s; explicit env wins).  Derived from the actual stall bound
+    # so tightening BENCH_STALL_S keeps the invariant.
+    _stall = float(os.environ.get("BENCH_STALL_S", "300"))
+    _autotune_cap = min(150.0, _stall / 2) if _stall > 0 else 150.0
+    os.environ.setdefault("CYCLONUS_AUTOTUNE_TIMEOUT_S", f"{_autotune_cap:g}")
     sharded = os.environ.get("BENCH_SHARDED", "") == "1"
     # BENCH_SHARDED selects the full-grid mesh path, which the tiled
     # default would otherwise shadow
