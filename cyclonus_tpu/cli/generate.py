@@ -143,9 +143,23 @@ def run_generate(args) -> int:
     validate_tags(args.include)
     validate_tags(excluded)
 
-    from ._cluster import close_cluster, make_cluster, perturbation_wait_seconds
+    from ._cluster import close_cluster, make_cluster
 
     kubernetes, protocols = make_cluster(args, protocols)
+    # pod servers (loopback subprocesses) exist from new_default onward;
+    # an exception mid-case must still close the cluster
+    try:
+        return _run_generate_cases(
+            args, kubernetes, namespaces, pods, ports, protocols, excluded
+        )
+    finally:
+        close_cluster(kubernetes)
+
+
+def _run_generate_cases(
+    args, kubernetes, namespaces, pods, ports, protocols, excluded
+) -> int:
+    from ._cluster import perturbation_wait_seconds
 
     resources = Resources.new_default(
         kubernetes,
@@ -255,7 +269,6 @@ def run_generate(args) -> int:
                 kubernetes.delete_namespace(ns)
             except Exception as e:
                 print(f"unable to delete namespace {ns}: {e}")
-    close_cluster(kubernetes)
     # a conformance runner that exits 0 on failing cases gives CI a
     # permanently green signal; the summary already printed the detail
     if failed:

@@ -104,9 +104,19 @@ def run_probe(args) -> int:
     ports = args.server_port or [80, 81]
     protocols = [p.upper() for p in (args.server_protocol or ["TCP", "UDP", "SCTP"])]
 
-    from ._cluster import close_cluster, make_cluster, perturbation_wait_seconds
+    from ._cluster import close_cluster, make_cluster
 
     kubernetes, protocols = make_cluster(args, protocols)
+    # pod servers (loopback subprocesses) exist from new_default onward;
+    # an exception anywhere past this point must still close the cluster
+    try:
+        return _run_probe_cases(args, kubernetes, namespaces, pods, ports, protocols)
+    finally:
+        close_cluster(kubernetes)
+
+
+def _run_probe_cases(args, kubernetes, namespaces, pods, ports, protocols) -> int:
+    from ._cluster import perturbation_wait_seconds
 
     resources = Resources.new_default(
         kubernetes,
@@ -188,5 +198,4 @@ def run_probe(args) -> int:
             test_case
         )
         printer.print_test_case_result(result)
-    close_cluster(kubernetes)
     return 0

@@ -629,6 +629,15 @@ class TpuPolicyEngine:
         # steady-state call); True/False = slab kernel chosen/rejected
         self._slab_choice = None
         self._slab_autotune = None  # {"default_s", "slab_s"} once timed
+        # slab HBM cost scales with the port-case count, but the plan and
+        # choice persist for the engine's life; dispatch re-checks the
+        # budget against the ACTUAL q (plan time budgets q=2)
+        self._slab_bytes_per_case = None
+        self._slab_budget = None
+        # set after an autotune TIMEOUT: {"event": Event, "waited": bool}
+        # — the abandoned candidate thread's completion marker; dispatches
+        # gate on it (_drain_autotune_orphan)
+        self._autotune_orphan = None
         self._counts_packed_jit = None
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
@@ -879,10 +888,12 @@ class TpuPolicyEngine:
         # ~150k pods their bytes explode quadratically-in-tiles and the
         # chunked kernels win.  Budget both directions at 2 port cases.
         n_tiles = -(-n_b // SLAB_BS) + -(-n_b // SLAB_BD)
-        if 2 * n_tiles * SLAB_W * n_b > int(
-            os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30))
-        ):
+        bytes_per_case = n_tiles * SLAB_W * n_b
+        budget = int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
+        if 2 * bytes_per_case > budget:
             return None
+        self._slab_bytes_per_case = bytes_per_case
+        self._slab_budget = budget
         import jax
 
         n = self.encoding.cluster.n_pods
@@ -918,6 +929,33 @@ class TpuPolicyEngine:
             # plan would break the invariant autotune readers rely on)
             self._slab_choice = True
         return plan
+
+    def _drain_autotune_orphan(self) -> None:
+        """After an autotune timeout the abandoned daemon thread can
+        still hold one in-flight compile+execution on the same backend.
+        Before the next dispatch, wait briefly for it to finish (first
+        call only; waiting forever would turn the contained candidate
+        failure into the very stall it guards against).  Every dispatch
+        that proceeds while the orphan is still live is counted in the
+        autotune telemetry, so a polluted timing is recognizable."""
+        orphan = self._autotune_orphan
+        if orphan is None:
+            return
+        import os
+
+        timeout = (
+            0.0
+            if orphan["waited"]
+            else float(os.environ.get("CYCLONUS_AUTOTUNE_DRAIN_S", "5"))
+        )
+        orphan["waited"] = True
+        if orphan["event"].wait(timeout):
+            self._autotune_orphan = None
+            return
+        if self._slab_autotune is not None:
+            self._slab_autotune["orphan_overlap_dispatches"] = (
+                self._slab_autotune.get("orphan_overlap_dispatches", 0) + 1
+            )
 
     def _autotune_slab(self, n32, slab_args):
         """Steady-state kernel autotune: time the default and the slab
@@ -958,11 +996,20 @@ class TpuPolicyEngine:
         # timing loop there, so at most one spurious slab execution
         # competes with the caller's subsequent default-path work.
         import os
+        import threading
 
         from ..utils.bounded import run_bounded
 
         timeout_s = float(os.environ.get("CYCLONUS_AUTOTUNE_TIMEOUT_S", "240"))
-        status, value = run_bounded(lambda: timed(slab_args), timeout_s)
+        candidate_done = threading.Event()
+
+        def candidate():
+            try:
+                return timed(slab_args)
+            finally:
+                candidate_done.set()
+
+        status, value = run_bounded(candidate, timeout_s)
         if status != "ok":
             cancelled["v"] = True
             # compile/run failure or timeout: the candidate rejects
@@ -970,6 +1017,23 @@ class TpuPolicyEngine:
             # (this autotune is the only place the slab program runs
             # unforced, so the failure is contained here)
             self._slab_choice = False
+            # the rejection is telemetry too: BENCH detail must show WHY
+            # there are no timed legs, and whether the abandoned thread's
+            # in-flight work later raced a real dispatch
+            self._slab_autotune = {
+                "default_s": round(t_default, 4),
+                "candidate": status,
+                "candidate_error": None if status == "timeout" else repr(value),
+                "orphan_overlap_dispatches": 0,
+            }
+            if status == "timeout":
+                # the abandoned daemon thread may still hold one in-flight
+                # compile+execution; gate the NEXT dispatch on it so a
+                # spurious slab execution cannot silently pollute the
+                # default path's first timed leg (_drain_autotune_orphan)
+                self._autotune_orphan = {
+                    "event": candidate_done, "waited": False
+                }
             logging.getLogger(__name__).warning(
                 "slab autotune: candidate %s (%s) -> default",
                 "timed out" if status == "timeout" else "failed",
@@ -1096,16 +1160,24 @@ class TpuPolicyEngine:
             with phase("engine.slab_plan"):
                 self._slab_plan_state = self._slab_plan(self._pod_perm_host)
         slab = self._slab_plan_state
+        # the plan budgeted HBM at q=2 port cases, but the slab
+        # materializes [q, ...] copies: a later call with a larger case
+        # list must fall back to the default kernel, not OOM the device
+        slab_ok = bool(slab) and (
+            self._slab_bytes_per_case is None
+            or len(cases) * self._slab_bytes_per_case <= self._slab_budget
+        )
         # until an auto plan is tuned-in, every path runs the default
         # kernel; a forced plan (CYCLONUS_PALLAS_SLAB=1) sets the choice
         # to True at plan time
         slab_args = (
             (slab["egress"], slab["ingress"])
-            if slab and self._slab_choice is True
+            if slab_ok and self._slab_choice is True
             else (None, None)
         )
         if self._counts_packed_jit is None:
             self._build_counts_jits()
+        self._drain_autotune_orphan()
         from .pallas_kernel import sum_partials
 
         q_port, q_name, q_proto = self._port_case_arrays(cases)
@@ -1113,7 +1185,7 @@ class TpuPolicyEngine:
         if self._pre_cache is not None and self._pre_cache[0] == key:
             # steady state: only the pallas counts kernel runs
             self._pre_cache_misses = 0
-            if slab and self._slab_choice is None:
+            if slab_ok and self._slab_choice is None:
                 # autotune at the first steady-state call: both programs
                 # run from the SAME pinned precompute, so this times
                 # exactly what every later call will execute

@@ -72,8 +72,14 @@ def native_probe(
             s.connect((host, port))
             data = s.recv(1)
         else:
-            s.sendto(b"?", (host, port))
-            data, _addr = s.recvfrom(1)
+            # connect() the UDP socket so the kernel filters datagrams
+            # from any peer other than (host, port) — otherwise a stray
+            # datagram on the bound port could flip a blocked verdict to
+            # allowed.  Bonus: ICMP port-unreachable surfaces as
+            # ECONNREFUSED instead of a 1 s timeout.
+            s.connect((host, port))
+            s.send(b"?")
+            data = s.recv(1)
         return None if data == _ACK else "closed without ack"
     except socket.timeout:
         return "timeout"

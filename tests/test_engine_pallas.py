@@ -579,6 +579,85 @@ class TestPallasCounts:
         got = sum_partials(np.asarray(partials), len(CASES), len(pods))
         assert got["combined"] == want["combined"]
 
+    def test_slab_autotune_rejection_telemetry_and_orphan_gating(
+        self, monkeypatch
+    ):
+        """A rejected candidate must leave telemetry (WHY there are no
+        timed legs), and after a TIMEOUT the next dispatch must gate on
+        the abandoned thread: wait briefly for it, count the overlap if
+        it is still in flight, and never let its stray execution race a
+        real dispatch unrecorded."""
+        import threading
+        import time as _t
+
+        import cyclonus_tpu.engine.pallas_kernel as pk
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        monkeypatch.setattr(pk, "SLAB_W", 8)
+        policy, pods, namespaces = fuzz_problem(38, n_extra_pods=9)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        for _ in range(3):
+            assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._pre_cache is not None
+        real = engine._counts_from_pre_jit
+
+        # --- error branch: telemetry, no orphan ---
+        def failing(pre, n, t0_e=None, t0_i=None):
+            if t0_e is not None:
+                raise RuntimeError("mosaic compile failure (simulated)")
+            return real(pre, n)
+
+        monkeypatch.setattr(engine, "_counts_from_pre_jit", failing)
+        engine._slab_choice = None
+        slab = engine._slab_plan_state
+        engine._autotune_slab(
+            np.int32(len(pods)), (slab["egress"], slab["ingress"])
+        )
+        tel = engine._slab_autotune
+        assert tel["candidate"] == "error"
+        assert "mosaic compile failure" in tel["candidate_error"]
+        assert "default_s" in tel
+        assert engine._autotune_orphan is None
+
+        # --- timeout branch: orphan gates the next dispatch ---
+        release = threading.Event()
+
+        def hanging(pre, n, t0_e=None, t0_i=None):
+            if t0_e is not None:
+                release.wait(30)
+            return real(pre, n)
+
+        monkeypatch.setattr(engine, "_counts_from_pre_jit", hanging)
+        monkeypatch.setenv("CYCLONUS_AUTOTUNE_TIMEOUT_S", "0.3")
+        engine._slab_choice = None
+        engine._autotune_slab(
+            np.int32(len(pods)), (slab["egress"], slab["ingress"])
+        )
+        assert engine._slab_autotune["candidate"] == "timeout"
+        assert engine._autotune_orphan is not None
+
+        # a dispatch while the orphan is live: brief wait times out,
+        # overlap counted, orphan kept for the non-blocking next check
+        monkeypatch.setenv("CYCLONUS_AUTOTUNE_DRAIN_S", "0.2")
+        monkeypatch.setattr(engine, "_counts_from_pre_jit", real)
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._slab_autotune["orphan_overlap_dispatches"] == 1
+        assert engine._autotune_orphan is not None
+
+        # once the orphan finishes, the next dispatch clears it without
+        # further counting
+        release.set()
+        deadline = _t.time() + 10
+        while not engine._autotune_orphan["event"].is_set():
+            assert _t.time() < deadline
+            _t.sleep(0.02)
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._autotune_orphan is None
+        assert engine._slab_autotune["orphan_overlap_dispatches"] == 1
+
     def test_slab_auto_mode_needs_tpu(self, monkeypatch):
         """The default 'auto' mode never engages off TPU (interpret-mode
         timing is meaningless): no plan, default kernels, counts
