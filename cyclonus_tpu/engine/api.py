@@ -865,7 +865,13 @@ class TpuPolicyEngine:
         engine."""
         import os
 
-        from .pallas_kernel import SLAB_BD, SLAB_BS, SLAB_W, slab_windows
+        from .pallas_kernel import (
+            SLAB_BD,
+            SLAB_BS,
+            SLAB_W,
+            slab_w_aug,
+            slab_windows,
+        )
 
         mode = os.environ.get("CYCLONUS_PALLAS_SLAB", "auto").lower()
         if mode == "auto":
@@ -888,7 +894,9 @@ class TpuPolicyEngine:
         # ~150k pods their bytes explode quadratically-in-tiles and the
         # chunked kernels win.  Budget both directions at 2 port cases.
         n_tiles = -(-n_b // SLAB_BS) + -(-n_b // SLAB_BD)
-        bytes_per_case = n_tiles * SLAB_W * n_b
+        # slab_w_aug: the kernel augments each window with the OR-term
+        # row and pads to the dtype sublane tile
+        bytes_per_case = n_tiles * slab_w_aug() * n_b
         budget = int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
         if 2 * bytes_per_case > budget:
             return None

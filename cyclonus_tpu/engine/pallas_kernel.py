@@ -638,6 +638,18 @@ SLAB_BS = 2048
 SLAB_BD = 1024
 
 
+def slab_w_aug(operand_dtype: str = None, w: int = None) -> int:
+    """Augmented window depth the slab kernel actually materializes:
+    the w-row window + the pseudo/validity OR-term row, padded to the
+    operand dtype's native sublane tile (int8: 32, bf16: 16).  The ONE
+    source of truth — the engine's HBM budget (api._slab_plan) must use
+    this, not re-derive it."""
+    if w is None:
+        w = SLAB_W
+    od = _resolve_operand_dtype(operand_dtype)
+    return w + (32 if od == "int8" else 16)
+
+
 def slab_windows(tmatch: "np.ndarray", tile: int, w: int = SLAB_W):
     """Per-tile target-window starts from a HOST (numpy, valid-masked)
     tmatch [T, N]: returns (t0 [n_tiles] int32, ok).  ok is False when
@@ -663,19 +675,21 @@ def slab_windows(tmatch: "np.ndarray", tile: int, w: int = SLAB_W):
 
 def _make_verdict_counts_kernel_slab():
     """Kernel body for the slab path: one matmul per direction over the
-    tile's SLAB_W-deep target window (values straight into the count
-    epilogue, like the 1chunk kernel), plus the pseudo/validity OR-terms
-    the windows exclude."""
+    tile's augmented target window (values straight into the count
+    epilogue, exactly like the 1chunk kernel).  The pseudo/validity
+    OR-terms ride INSIDE the window as one augmented row per direction
+    (appended at gather time by _verdict_counts_pallas_slab), so
+    `acc > 0` is the complete verdict.  An epilogue formulation was
+    tried and does not survive Mosaic: i1 minor-dim inserts
+    (`pe[:, None]`) are unsupported, 1-D int32 relayouts crash layout
+    inference, and rank-1 dot_general OR-terms blow the 16 MB scoped
+    VMEM stack at the (2048, 1024) tile."""
 
     def _kernel(
-        a_e_ref,  # [1, W, BS] od — tmatch_e window for src tile i
-        b_e_ref,  # [1, 1, W, BD] od — tallow_e window (q, src tile i, dst j)
-        b_i_ref,  # [1, 1, W, BS] od — tallow_i window (q, dst tile j, src i)
-        a_i_ref,  # [1, W, BD] od — tmatch_i window for dst tile j
-        pe_ref,  # [1, BS] od — pseudo_e (valid src with no egress target)
-        vd_ref,  # [1, BD] od — valid dst
-        pi_ref,  # [1, BD] od — pseudo_i (valid dst with no ingress target)
-        vs_ref,  # [1, BS] od — valid src
+        a_e_ref,  # [1, Wa, BS] od — tmatch_e window+pseudo row, src tile i
+        b_e_ref,  # [1, 1, Wa, BD] od — tallow_e window+valid row (q, i, j)
+        b_i_ref,  # [1, 1, Wa, BS] od — tallow_i window+valid row (q, j, i)
+        a_i_ref,  # [1, Wa, BD] od — tmatch_i window+pseudo row, dst tile j
         counts_ref,  # [1, n_i, 128] int32 per-q count plane
         cnt_ref,  # [1, 128] int32 scratch
     ):
@@ -707,13 +721,8 @@ def _make_verdict_counts_kernel_slab():
             preferred_element_type=acc_dt,
         )
         zero = jnp.array(0, acc_dt)
-        od_zero = jnp.array(0, a_e_ref.dtype)
-        pe = pe_ref[0] > od_zero  # [BS]
-        vd = vd_ref[0] > od_zero  # [BD]
-        pi = pi_ref[0] > od_zero  # [BD]
-        vs = vs_ref[0] > od_zero  # [BS]
-        egress = (acc_e > zero) | (pe[:, None] & vd[None, :])
-        ingress = (acc_i > zero) | (vs[:, None] & pi[None, :])
+        egress = acc_e > zero
+        ingress = acc_i > zero
         combined = egress & ingress
         c_in = jnp.sum(ingress.astype(jnp.int32))
         c_eg = jnp.sum(egress.astype(jnp.int32))
@@ -818,60 +827,76 @@ def _verdict_counts_pallas_slab(
     t0_e = jnp.clip(t0_e.astype(jnp.int32), 0, t_e_pad - w)
     t0_i = jnp.clip(t0_i.astype(jnp.int32), 0, t_i_pad - w)
 
+    # Augmented window depth: one extra row carries the pseudo/validity
+    # OR-term per direction (the kernel is then pure matmul + compare,
+    # mirroring the proven 1chunk body), padded to the dtype's native
+    # sublane tile so every block fetch stays aligned.
+    w_aug = slab_w_aug(operand_dtype, w)
+
     # slab gathers (per-eval; cacheable with the precompute when the
     # engine's device-resident pre-cache holds)
-    def gather_tm(tm, t0, tile, count):
+    def gather_tm(tm, t0, tile, count, pseudo):
+        """[count, w_aug, tile]: the w-row window, then the pseudo row
+        for this tile's pod columns, then alignment zeros."""
+
         def one(i, t0i):
             return jax.lax.dynamic_slice(tm, (t0i, i * tile), (w, tile))
 
-        return jax.vmap(one)(jnp.arange(count), t0)  # [count, w, tile]
+        win = jax.vmap(one)(jnp.arange(count), t0)  # [count, w, tile]
+        aug = pseudo.reshape(count, 1, tile)
+        pad = jnp.zeros((count, w_aug - w - 1, tile), dtype=win.dtype)
+        return jnp.concatenate([win, aug, pad], axis=1)
 
-    def gather_tl(tl, t0):
+    def gather_tl(tl, t0, vrow_other):
+        """[count, q, w_aug, n_other]: window + the valid row (the
+        OR-term's allow side) + alignment zeros."""
+
         def one(t0i):
             return jax.lax.dynamic_slice(
                 tl, (0, t0i, 0), (q, w, tl.shape[2])
             )
 
-        return jax.vmap(one)(t0)  # [count, q, w, n_other]
+        win = jax.vmap(one)(t0)  # [count, q, w, n_other]
+        count = win.shape[0]
+        n_other = win.shape[3]
+        aug = jnp.broadcast_to(
+            vrow_other[None, None, None, :], (count, q, 1, n_other)
+        ).astype(win.dtype)
+        pad = jnp.zeros((count, q, w_aug - w - 1, n_other), dtype=win.dtype)
+        return jnp.concatenate([win, aug, pad], axis=2)
 
-    a_e = gather_tm(tm_e, t0_e, bs, n_i)  # [n_i, w, bs]
-    a_i = gather_tm(tm_i, t0_i, bd, n_j)  # [n_j, w, bd]
-    b_e = jnp.moveaxis(gather_tl(tl_e, t0_e), 1, 0)  # [q, n_i, w, nd_pad]
-    b_i = jnp.moveaxis(gather_tl(tl_i, t0_i), 1, 0)  # [q, n_j, w, ns_pad]
+    pe = ((~has_e) & (jnp.arange(n) < n_pods)).astype(od)  # [N]
+    pi = ((~has_i) & (jnp.arange(n) < n_pods)).astype(od)
+    pe_s = _pad_to(pe[None, :], 1, bs)[0]  # [ns_pad]
+    pi_d = _pad_to(pi[None, :], 1, bd)[0]  # [nd_pad]
+    vs = _pad_to(valid[None, :], 1, bs)[0]  # [ns_pad]
+    vd = _pad_to(valid[None, :], 1, bd)[0]  # [nd_pad]
 
-    pe = (
-        ((~has_e) & (jnp.arange(n) < n_pods)).astype(od)[None, :]
-    )  # [1, N]
-    pi = ((~has_i) & (jnp.arange(n) < n_pods)).astype(od)[None, :]
-    vrow = valid[None, :]
-    pe = _pad_to(pe, 1, bs)
-    vs = _pad_to(vrow, 1, bs)
-    pi_d = _pad_to(pi, 1, bd)
-    vd = _pad_to(vrow, 1, bd)
+    # egress: acc[s, d] += pe[s] * vd[d]; ingress: acc[s, d] += vs[s] * pi[d]
+    a_e = gather_tm(tm_e, t0_e, bs, n_i, pe_s)  # [n_i, w_aug, bs]
+    a_i = gather_tm(tm_i, t0_i, bd, n_j, pi_d)  # [n_j, w_aug, bd]
+    b_e = jnp.moveaxis(gather_tl(tl_e, t0_e, vd), 1, 0)  # [q, n_i, w_aug, nd_pad]
+    b_i = jnp.moveaxis(gather_tl(tl_i, t0_i, vs), 1, 0)  # [q, n_j, w_aug, ns_pad]
 
     counts = pl.pallas_call(
         _make_verdict_counts_kernel_slab(),
         grid=(q, n_i, n_j),
         in_specs=[
-            pl.BlockSpec((1, w, bs), lambda q, i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, w, bd), lambda q, i, j: (q, i, 0, j)),
-            pl.BlockSpec((1, 1, w, bs), lambda q, i, j: (q, j, 0, i)),
-            pl.BlockSpec((1, w, bd), lambda q, i, j: (j, 0, 0)),
-            pl.BlockSpec((1, bs), lambda q, i, j: (0, i)),
-            pl.BlockSpec((1, bd), lambda q, i, j: (0, j)),
-            pl.BlockSpec((1, bd), lambda q, i, j: (0, j)),
-            pl.BlockSpec((1, bs), lambda q, i, j: (0, i)),
+            pl.BlockSpec((1, w_aug, bs), lambda q, i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, w_aug, bd), lambda q, i, j: (q, i, 0, j)),
+            pl.BlockSpec((1, 1, w_aug, bs), lambda q, i, j: (q, j, 0, i)),
+            pl.BlockSpec((1, w_aug, bd), lambda q, i, j: (j, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, n_i, 128), lambda q, i, j: (q, 0, 0)),
         scratch_shapes=[pltpu.VMEM((1, 128), jnp.int32)],
         out_shape=jax.ShapeDtypeStruct((q, n_i, 128), jnp.int32),
         cost_estimate=pl.CostEstimate(
-            flops=2 * q * ns_pad * nd_pad * 2 * w,
-            bytes_accessed=q * n_i * n_j * w * (bs + bd),
+            flops=2 * q * ns_pad * nd_pad * 2 * w_aug,
+            bytes_accessed=q * n_i * n_j * w_aug * (bs + bd),
             transcendentals=0,
         ),
         interpret=interpret,
-    )(a_e, b_e, b_i, a_i, pe, vd, pi_d, vs)
+    )(a_e, b_e, b_i, a_i)
     return counts[:, :, :3]
 
 

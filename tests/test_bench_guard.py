@@ -89,6 +89,35 @@ class TestBenchGuards:
         assert "error" in out
         assert "not-a-backend" in out["error"]
 
+    def test_init_timeout_attaches_cpu_fallback_leg(self):
+        """A dead tunnel (simulated via BENCH_FAKE_INIT_HANG) must still
+        produce an artifact with SIGNAL: value 0 for the TPU metric, but
+        a small identical-pipeline CPU leg under detail.cpu_fallback."""
+        proc = run_bench(
+            {
+                "BENCH_FAKE_INIT_HANG": "1",
+                "BENCH_INIT_DEADLINE_S": "2",
+                "BENCH_PODS": "64",
+                "BENCH_POLICIES": "8",
+                "BENCH_FALLBACK_PODS": "128",
+                "BENCH_FALLBACK_POLICIES": "16",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+                "BENCH_DEADLINE_S": "0",
+                "BENCH_STALL_S": "0",
+            },
+            timeout=400,
+        )
+        assert proc.returncode == 3
+        out = last_json_line(proc.stdout)
+        assert "backend init did not complete" in out["error"]
+        assert out["value"] == 0
+        leg = out["detail"]["cpu_fallback"]
+        assert leg["backend"] == "cpu"
+        assert leg["value"] > 0
+        assert leg["unit"] == "cells/sec"
+        assert "128 pods" in leg["metric"]
+
     def test_success_line_parses_with_detail_blocks(self):
         proc = run_bench(
             {

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Tunnel watchdog: probe the TPU backend all round, fire the bench the
+moment a device answers (VERDICT r4 next-round item 1).
+
+Rounds 3 and 4 lost their perf scoreboard to a dead remote-TPU tunnel:
+the driver's single end-of-round bench attempt found no device and
+recorded 0 cells/s, while nothing retried in between.  This tool is the
+retry: run it first thing (in the background) and it probes the backend
+with a BOUNDED subprocess every PROBE_INTERVAL_S; whenever the tunnel is
+alive and the last bench artifact is stale, it runs the full bench and
+writes the JSON to --out (default artifacts/bench_watchdog_latest.json,
+plus a timestamped copy).  Every attempt is appended to the log so the
+round's tunnel-availability history is itself evidence.
+
+The probe is a SUBPROCESS because a wedged `jax.devices()` blocks its
+process forever (utils/bounded.py docstring); a fresh interpreter per
+probe is the only reliable bound.
+
+Usage:
+    python tools/tunnel_wait.py [--interval 300] [--max-hours 11]
+        [--once] [--out artifacts/bench_watchdog_latest.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE_CODE = (
+    "import jax; ds = jax.devices(); "
+    "import sys; sys.exit(0 if any('tpu' in str(d).lower() or "
+    "'TPU' in str(d) for d in ds) else 3)"
+)
+
+
+def probe_tunnel(bound_s: float = 90.0) -> bool:
+    """True iff a fresh interpreter can enumerate a TPU device within
+    bound_s.  Timeout/crash/non-TPU all count as dead."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True,
+            timeout=bound_s,
+            cwd=REPO,
+        )
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def run_bench(out_path: str, bound_s: float = 1500.0) -> dict:
+    """One full bench attempt; returns the parsed JSON line (or an error
+    dict).  The bench's own watchdogs bound the common failure modes;
+    the subprocess timeout is the backstop."""
+    rc = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "bench.py"],
+            capture_output=True,
+            text=True,
+            timeout=bound_s,
+            cwd=REPO,
+        )
+        rc = proc.returncode
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        result = (
+            json.loads(lines[-1])
+            if lines
+            else {"error": f"bench produced no JSON (rc={rc})"}
+        )
+    except subprocess.TimeoutExpired:
+        result = {"error": f"bench exceeded the {bound_s:g}s subprocess bound"}
+    result["bench_rc"] = rc
+    result["at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+        f.write("\n")
+    stamped = out_path.replace(
+        ".json", time.strftime("-%Y%m%d-%H%M%S.json")
+    )
+    with open(stamped, "w") as f:
+        json.dump(result, f)
+        f.write("\n")
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between tunnel probes (default 300)")
+    ap.add_argument("--max-hours", type=float, default=11.0,
+                    help="give up after this many hours (default 11)")
+    ap.add_argument("--once", action="store_true",
+                    help="probe once; bench if alive; exit")
+    ap.add_argument("--out", default="artifacts/bench_watchdog_latest.json")
+    ap.add_argument("--probe-bound", type=float, default=90.0)
+    ap.add_argument(
+        "--rebench-every", type=float, default=3600.0,
+        help="re-run the bench if the last success is older than this "
+        "(a fresh artifact beats a stale one; default 1h)",
+    )
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    last_success = 0.0
+    while True:
+        alive = probe_tunnel(args.probe_bound)
+        now = time.strftime("%H:%M:%S")
+        if alive and (time.time() - last_success) >= args.rebench_every:
+            print(f"[{now}] tunnel ALIVE -> running bench", flush=True)
+            result = run_bench(args.out)
+            ok = "error" not in result and result.get("value", 0) > 0
+            print(
+                f"[{time.strftime('%H:%M:%S')}] bench "
+                f"{'OK value=' + str(result.get('value')) if ok else 'FAILED: ' + str(result.get('error'))[:120]}",
+                flush=True,
+            )
+            if ok:
+                last_success = time.time()
+        else:
+            state = "alive (artifact fresh)" if alive else "DEAD"
+            print(f"[{now}] tunnel {state}", flush=True)
+        if args.once:
+            return 0 if alive else 3
+        if time.time() >= deadline:
+            print("max duration reached; exiting", flush=True)
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
