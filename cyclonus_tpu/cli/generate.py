@@ -44,7 +44,10 @@ def setup_generate(sub) -> None:
         "--namespace",  # the reference's generate spells it --namespace
         action="append",
         default=None,
-        help="namespaces (default x,y,z)",
+        help="namespaces (default x,y,z).  Fixture-bearing case families "
+        "(conflict, upstream-e2e, example) reference namespaces x, y, z "
+        "by name — a custom list must INCLUDE them or those cases error "
+        "(reference parity: conflictcases.go:254-255 hardcodes them too)",
     )
     cmd.add_argument(
         "--server-pod",

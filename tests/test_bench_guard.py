@@ -31,9 +31,12 @@ def run_bench(env_extra, timeout=120):
 
 
 def last_json_line(stdout):
-    lines = [l for l in stdout.splitlines() if l.startswith("{")]
-    assert lines, f"no JSON line in output: {stdout[-500:]}"
-    return json.loads(lines[-1])
+    sys.path.insert(0, REPO)
+    from bench import last_json_line as parse
+
+    out = parse(stdout)
+    assert out is not None, f"no JSON line in output: {stdout[-500:]}"
+    return out
 
 
 class TestBenchGuards:

@@ -54,10 +54,22 @@ def probe_tunnel(bound_s: float = 90.0) -> bool:
         return False
 
 
-def run_bench(out_path: str, bound_s: float = 1500.0) -> dict:
+def run_bench(out_path: str, bound_s: float = None) -> dict:
     """One full bench attempt; returns the parsed JSON line (or an error
-    dict).  The bench's own watchdogs bound the common failure modes;
-    the subprocess timeout is the backstop."""
+    dict).  The bench's own watchdogs are the real bounds — they print
+    the diagnostic JSON with phase history that this tool exists to
+    capture — so the subprocess backstop must fire strictly AFTER them
+    (inner deadline + margin), never first.
+
+    --out only ever holds the LATEST SUCCESS (value > 0); failed
+    attempts go to a .failed.json sibling, so a mid-round tunnel death
+    cannot clobber a same-round success.  Every attempt also gets a
+    timestamped copy — the round's availability history."""
+    if bound_s is None:
+        bound_s = float(os.environ.get("BENCH_DEADLINE_S", "1500")) + 300.0
+    sys.path.insert(0, REPO)
+    from bench import last_json_line
+
     rc = None
     try:
         proc = subprocess.run(
@@ -68,18 +80,17 @@ def run_bench(out_path: str, bound_s: float = 1500.0) -> dict:
             cwd=REPO,
         )
         rc = proc.returncode
-        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
-        result = (
-            json.loads(lines[-1])
-            if lines
-            else {"error": f"bench produced no JSON (rc={rc})"}
-        )
+        result = last_json_line(proc.stdout) or {
+            "error": f"bench produced no JSON (rc={rc})"
+        }
     except subprocess.TimeoutExpired:
         result = {"error": f"bench exceeded the {bound_s:g}s subprocess bound"}
     result["bench_rc"] = rc
     result["at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-    with open(out_path, "w") as f:
+    ok = "error" not in result and result.get("value", 0) > 0
+    target = out_path if ok else out_path.replace(".json", ".failed.json")
+    with open(target, "w") as f:
         json.dump(result, f)
         f.write("\n")
     stamped = out_path.replace(
@@ -110,25 +121,31 @@ def main() -> int:
 
     deadline = time.time() + args.max_hours * 3600
     last_success = 0.0
+    benched_ok = None  # tri-state for --once: None = bench never ran
     while True:
         alive = probe_tunnel(args.probe_bound)
         now = time.strftime("%H:%M:%S")
         if alive and (time.time() - last_success) >= args.rebench_every:
             print(f"[{now}] tunnel ALIVE -> running bench", flush=True)
             result = run_bench(args.out)
-            ok = "error" not in result and result.get("value", 0) > 0
+            benched_ok = "error" not in result and result.get("value", 0) > 0
             print(
                 f"[{time.strftime('%H:%M:%S')}] bench "
-                f"{'OK value=' + str(result.get('value')) if ok else 'FAILED: ' + str(result.get('error'))[:120]}",
+                f"{'OK value=' + str(result.get('value')) if benched_ok else 'FAILED: ' + str(result.get('error'))[:120]}",
                 flush=True,
             )
-            if ok:
+            if benched_ok:
                 last_success = time.time()
         else:
             state = "alive (artifact fresh)" if alive else "DEAD"
             print(f"[{now}] tunnel {state}", flush=True)
         if args.once:
-            return 0 if alive else 3
+            # rc reflects the OUTCOME, not just the probe: a caller
+            # gating on --once must not mistake "tunnel answered but
+            # the bench failed" for a produced artifact
+            if not alive:
+                return 3
+            return 0 if benched_ok in (True, None) else 4
         if time.time() >= deadline:
             print("max duration reached; exiting", flush=True)
             return 0
