@@ -658,6 +658,25 @@ class TestPallasCounts:
         assert engine._autotune_orphan is None
         assert engine._slab_autotune["orphan_overlap_dispatches"] == 1
 
+    def test_counts_pipelined_eval(self):
+        """counts_pipelined_eval_s: None before the pinned-precompute
+        steady state, then (seconds, counts) with counts identical to
+        the sync path — the device-throughput leg the bench records."""
+        policy, pods, namespaces = fuzz_problem(40, n_extra_pods=7)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        assert engine.counts_pipelined_eval_s(CASES) is None  # cold
+        for _ in range(3):
+            assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        got = engine.counts_pipelined_eval_s(CASES, reps=3)
+        assert got is not None
+        dt, counts = got
+        assert dt > 0
+        assert counts == want
+        # a different case set is not at steady state
+        other = [PortCase(9999, "", "TCP")]
+        assert engine.counts_pipelined_eval_s(other) is None
+
     def test_slab_auto_mode_needs_tpu(self, monkeypatch):
         """The default 'auto' mode never engages off TPU (interpret-mode
         timing is meaningless): no plan, default kernels, counts
