@@ -1,0 +1,102 @@
+"""Unit tests for tools/tunnel_wait.py — the round-long bench watchdog.
+The subprocess boundary is stubbed; what's under test is the artifact
+routing (success vs .failed.json), the backstop arithmetic, and the
+JSON parsing contract shared with bench.last_json_line."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+import tunnel_wait
+
+
+class _Proc:
+    def __init__(self, stdout, rc=0):
+        self.stdout = stdout
+        self.returncode = rc
+
+
+class TestRunBench:
+    def _run(self, monkeypatch, tmp_path, stdout, rc=0, raise_timeout=False):
+        def fake_run(*a, **kw):
+            if raise_timeout:
+                raise subprocess.TimeoutExpired(cmd="bench", timeout=1)
+            return _Proc(stdout, rc)
+
+        monkeypatch.setattr(tunnel_wait.subprocess, "run", fake_run)
+        out = str(tmp_path / "latest.json")
+        result = tunnel_wait.run_bench(out, bound_s=5)
+        return result, out
+
+    def test_success_written_to_latest(self, monkeypatch, tmp_path):
+        line = json.dumps({"metric": "m", "value": 123, "unit": "cells/sec"})
+        result, out = self._run(monkeypatch, tmp_path, f"noise\n{line}\n")
+        assert result["value"] == 123
+        assert result["bench_rc"] == 0
+        assert json.load(open(out))["value"] == 123
+        assert not os.path.exists(out.replace(".json", ".failed.json"))
+
+    def test_failure_does_not_clobber_success(self, monkeypatch, tmp_path):
+        good = json.dumps({"metric": "m", "value": 99, "unit": "cells/sec"})
+        self._run(monkeypatch, tmp_path, f"{good}\n")
+        bad = json.dumps({"metric": "m", "value": 0, "error": "tunnel dead"})
+        result, out = self._run(monkeypatch, tmp_path, f"{bad}\n", rc=3)
+        assert result["error"] == "tunnel dead"
+        # the latest-success artifact survives; the failure lands aside
+        assert json.load(open(out))["value"] == 99
+        failed = out.replace(".json", ".failed.json")
+        assert json.load(open(failed))["error"] == "tunnel dead"
+
+    def test_no_json_output(self, monkeypatch, tmp_path):
+        result, out = self._run(monkeypatch, tmp_path, "garbage only\n", rc=7)
+        assert "no JSON" in result["error"]
+        assert result["bench_rc"] == 7
+
+    def test_subprocess_timeout(self, monkeypatch, tmp_path):
+        result, out = self._run(
+            monkeypatch, tmp_path, "", raise_timeout=True
+        )
+        assert "subprocess bound" in result["error"]
+        assert result["bench_rc"] is None
+
+    def test_backstop_exceeds_inner_deadline(self, monkeypatch):
+        """The subprocess bound must fire AFTER bench.py's own watchdog
+        (which prints the diagnostic JSON this tool exists to capture)."""
+        captured = {}
+
+        def fake_run(*a, timeout=None, **kw):
+            captured["timeout"] = timeout
+            return _Proc(json.dumps({"value": 1, "metric": "m"}) + "\n")
+
+        monkeypatch.setattr(tunnel_wait.subprocess, "run", fake_run)
+        monkeypatch.setenv("BENCH_DEADLINE_S", "700")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            tunnel_wait.run_bench(os.path.join(d, "o.json"))
+        assert captured["timeout"] > 700
+
+
+class TestProbe:
+    def test_probe_timeout_counts_dead(self, monkeypatch):
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="p", timeout=1)
+
+        monkeypatch.setattr(tunnel_wait.subprocess, "run", fake_run)
+        assert tunnel_wait.probe_tunnel(0.1) is False
+
+    def test_probe_rc_maps(self, monkeypatch):
+        for rc, want in ((0, True), (3, False), (1, False)):
+            monkeypatch.setattr(
+                tunnel_wait.subprocess,
+                "run",
+                lambda *a, _rc=rc, **kw: _Proc("", _rc),
+            )
+            assert tunnel_wait.probe_tunnel(0.1) is want
