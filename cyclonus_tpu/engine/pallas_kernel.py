@@ -767,15 +767,17 @@ def verdict_counts_pallas_slab(
     check from the kernel's actual window.
 
     Design note: the slabs are MATERIALIZED per-tile gathers — [q,
-    n_tiles, w, N] in HBM, rebuilt per dispatch — which caps this path
-    at ~150k pods (the caller gates on the byte estimate).  The
-    alternative (scalar-prefetch block maps into the original arrays,
-    like the general kernel's nz redirects) avoids the copies and the
-    cap, but block index maps are w-ALIGNED, so covering an arbitrary
-    <=w/2-wide span needs a 2-block window — doubling the contraction
-    depth and giving back most of the win at the 100k bench shape
-    (depth 512 vs this path's 256; a 256-aligned windowing measured
-    only 10-15% in round 3)."""
+    n_tiles, w_aug, N] in HBM — which caps this path at ~150k pods (the
+    caller gates on the byte estimate).  This composed form rebuilds
+    them per dispatch; steady-state callers should build them once with
+    slab_operands and dispatch verdict_counts_pallas_slab_from_ops
+    (r5 measured the rebuild at more than the depth cut's savings).
+    The alternative (scalar-prefetch block maps into the original
+    arrays, like the general kernel's nz redirects) avoids the copies
+    and the cap, but block index maps are w-ALIGNED, so covering an
+    arbitrary <=w/2-wide span needs a 2-block window — doubling the
+    contraction depth and giving back most of the win at the 100k bench
+    shape."""
     return _verdict_counts_pallas_slab(
         tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
         t0_e, t0_i, n_pods,
@@ -787,12 +789,33 @@ def verdict_counts_pallas_slab(
     )
 
 
-@partial(
-    jax.jit, static_argnames=("interpret", "operand_dtype", "bs", "bd", "w")
-)
-def _verdict_counts_pallas_slab(
+def slab_operands(
     tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
-    t0_e, t0_i, n_pods, interpret, operand_dtype, bs, bd, w,
+    t0_e, t0_i, n_pods, operand_dtype=None, bs=None, bd=None, w=None,
+):
+    """The slab path's gathered operands — {a_e, b_e, b_i, a_i} — as a
+    SEPARATE traceable stage: they depend only on the precompute and the
+    (fixed) window starts, so a steady-state caller can materialize them
+    ONCE and cache them device-resident next to the precompute.  Round-5
+    measurement: rebuilding these per dispatch (the original fused form)
+    cost more than the slab's depth cut saved, flipping the kernel from
+    a ~2x device-time win to a 22% loss."""
+    return _slab_operands(
+        tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+        t0_e, t0_i, n_pods,
+        operand_dtype=_resolve_operand_dtype(operand_dtype),
+        bs=bs if bs is not None else SLAB_BS,
+        bd=bd if bd is not None else SLAB_BD,
+        w=w if w is not None else SLAB_W,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("operand_dtype", "bs", "bd", "w")
+)
+def _slab_operands(
+    tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+    t0_e, t0_i, n_pods, operand_dtype, bs, bd, w,
 ):
     od = jnp.bfloat16 if operand_dtype == "bf16" else jnp.int8
     n = tmatch_e.shape[1]
@@ -877,7 +900,19 @@ def _verdict_counts_pallas_slab(
     a_i = gather_tm(tm_i, t0_i, bd, n_j, pi_d)  # [n_j, w_aug, bd]
     b_e = jnp.moveaxis(gather_tl(tl_e, t0_e, vd), 1, 0)  # [q, n_i, w_aug, nd_pad]
     b_i = jnp.moveaxis(gather_tl(tl_i, t0_i, vs), 1, 0)  # [q, n_j, w_aug, ns_pad]
+    return {"a_e": a_e, "b_e": b_e, "b_i": b_i, "a_i": a_i}
 
+
+def verdict_counts_pallas_slab_from_ops(ops, interpret: bool = False):
+    """[Q, n_i, 3] int32 partials from pre-gathered slab operands
+    (slab_operands).  Every layout parameter is derived from the operand
+    shapes, so cached operands can never desynchronize from the kernel's
+    block specs."""
+    a_e, b_e, b_i, a_i = ops["a_e"], ops["b_e"], ops["b_i"], ops["a_i"]
+    n_i, w_aug, bs = a_e.shape
+    n_j, _, bd = a_i.shape
+    q = b_e.shape[0]
+    ns_pad, nd_pad = n_i * bs, n_j * bd
     counts = pl.pallas_call(
         _make_verdict_counts_kernel_slab(),
         grid=(q, n_i, n_j),
@@ -898,6 +933,21 @@ def _verdict_counts_pallas_slab(
         interpret=interpret,
     )(a_e, b_e, b_i, a_i)
     return counts[:, :, :3]
+
+
+@partial(
+    jax.jit, static_argnames=("interpret", "operand_dtype", "bs", "bd", "w")
+)
+def _verdict_counts_pallas_slab(
+    tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+    t0_e, t0_i, n_pods, interpret, operand_dtype, bs, bd, w,
+):
+    ops = _slab_operands(
+        tmatch_e, has_e, tallow_e, tmatch_i, has_i, tallow_i,
+        t0_e, t0_i, n_pods,
+        operand_dtype=operand_dtype, bs=bs, bd=bd, w=w,
+    )
+    return verdict_counts_pallas_slab_from_ops(ops, interpret=interpret)
 
 
 def sum_partials(partials, q: int, n_pods: int) -> Dict[str, int]:

@@ -506,11 +506,12 @@ class TestPallasCounts:
             assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
         assert engine._pre_cache is not None
         engine._slab_choice = None
-        slab = engine._slab_plan_state
-        partials = engine._autotune_slab(
-            np.int32(len(pods)), (slab["egress"], slab["ingress"])
-        )
+        key = engine._steady_state_args(CASES)[0]
+        partials = engine._autotune_slab(np.int32(len(pods)), key)
         assert engine._slab_choice in (True, False)
+        # the candidate leg built and cached the gathered slab operands
+        assert engine._slab_ops_cache is not None
+        assert engine._slab_ops_cache[0] == key
         got = sum_partials(np.asarray(partials), len(CASES), len(pods))
         for k in ("ingress", "egress", "combined"):
             assert got[k] == want[k]
@@ -538,42 +539,39 @@ class TestPallasCounts:
             assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
         assert engine._pre_cache is not None
         engine._slab_choice = None
-        real = engine._counts_from_pre_jit
+        key = engine._steady_state_args(CASES)[0]
 
-        def flaky(pre, n, t0_e=None, t0_i=None):
-            if t0_e is not None:
-                raise RuntimeError("mosaic compile failure (simulated)")
-            return real(pre, n)
+        def failing_slab(ops):
+            raise RuntimeError("mosaic compile failure (simulated)")
 
-        monkeypatch.setattr(engine, "_counts_from_pre_jit", flaky)
-        slab = engine._slab_plan_state
-        partials = engine._autotune_slab(
-            np.int32(len(pods)), (slab["egress"], slab["ingress"])
+        monkeypatch.setattr(
+            engine, "_counts_from_slab_ops_jit", failing_slab
         )
+        partials = engine._autotune_slab(np.int32(len(pods)), key)
         assert engine._slab_choice is False
+        # a rejected candidate must not leave its operands pinned
+        assert engine._slab_ops_cache is None
         got = sum_partials(np.asarray(partials), len(CASES), len(pods))
         for k in ("ingress", "egress", "combined"):
             assert got[k] == want[k]
-        # the rejection sticks: later calls run the default path through
-        # the flaky jit without touching the slab leg
+        # the rejection sticks: later calls run the default path without
+        # touching the failing slab leg
         assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
 
         # a HANGING candidate (wedged remote compile) must also reject
         # via the bounded leg, not stall the caller
         import time as _t
 
-        def hanging(pre, n, t0_e=None, t0_i=None):
-            if t0_e is not None:
-                _t.sleep(30)
-            return real(pre, n)
+        def hanging_slab(ops):
+            _t.sleep(30)
 
-        monkeypatch.setattr(engine, "_counts_from_pre_jit", hanging)
+        monkeypatch.setattr(
+            engine, "_counts_from_slab_ops_jit", hanging_slab
+        )
         monkeypatch.setenv("CYCLONUS_AUTOTUNE_TIMEOUT_S", "0.5")
         engine._slab_choice = None
         t0 = _t.time()
-        partials = engine._autotune_slab(
-            np.int32(len(pods)), (slab["egress"], slab["ingress"])
-        )
+        partials = engine._autotune_slab(np.int32(len(pods)), key)
         assert _t.time() - t0 < 10
         assert engine._slab_choice is False
         got = sum_partials(np.asarray(partials), len(CASES), len(pods))
@@ -602,20 +600,16 @@ class TestPallasCounts:
         for _ in range(3):
             assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
         assert engine._pre_cache is not None
-        real = engine._counts_from_pre_jit
+        real_slab = engine._counts_from_slab_ops_jit
+        key = engine._steady_state_args(CASES)[0]
 
         # --- error branch: telemetry, no orphan ---
-        def failing(pre, n, t0_e=None, t0_i=None):
-            if t0_e is not None:
-                raise RuntimeError("mosaic compile failure (simulated)")
-            return real(pre, n)
+        def failing(ops):
+            raise RuntimeError("mosaic compile failure (simulated)")
 
-        monkeypatch.setattr(engine, "_counts_from_pre_jit", failing)
+        monkeypatch.setattr(engine, "_counts_from_slab_ops_jit", failing)
         engine._slab_choice = None
-        slab = engine._slab_plan_state
-        engine._autotune_slab(
-            np.int32(len(pods)), (slab["egress"], slab["ingress"])
-        )
+        engine._autotune_slab(np.int32(len(pods)), key)
         tel = engine._slab_autotune
         assert tel["candidate"] == "error"
         assert "mosaic compile failure" in tel["candidate_error"]
@@ -625,24 +619,23 @@ class TestPallasCounts:
         # --- timeout branch: orphan gates the next dispatch ---
         release = threading.Event()
 
-        def hanging(pre, n, t0_e=None, t0_i=None):
-            if t0_e is not None:
-                release.wait(30)
-            return real(pre, n)
+        def hanging(ops):
+            release.wait(30)
+            return real_slab(ops)
 
-        monkeypatch.setattr(engine, "_counts_from_pre_jit", hanging)
+        monkeypatch.setattr(engine, "_counts_from_slab_ops_jit", hanging)
         monkeypatch.setenv("CYCLONUS_AUTOTUNE_TIMEOUT_S", "0.3")
         engine._slab_choice = None
-        engine._autotune_slab(
-            np.int32(len(pods)), (slab["egress"], slab["ingress"])
-        )
+        engine._autotune_slab(np.int32(len(pods)), key)
         assert engine._slab_autotune["candidate"] == "timeout"
         assert engine._autotune_orphan is not None
 
         # a dispatch while the orphan is live: brief wait times out,
         # overlap counted, orphan kept for the non-blocking next check
         monkeypatch.setenv("CYCLONUS_AUTOTUNE_DRAIN_S", "0.2")
-        monkeypatch.setattr(engine, "_counts_from_pre_jit", real)
+        monkeypatch.setattr(
+            engine, "_counts_from_slab_ops_jit", real_slab
+        )
         assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
         assert engine._slab_autotune["orphan_overlap_dispatches"] == 1
         assert engine._autotune_orphan is not None
@@ -657,6 +650,36 @@ class TestPallasCounts:
         assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
         assert engine._autotune_orphan is None
         assert engine._slab_autotune["orphan_overlap_dispatches"] == 1
+
+    def test_slab_ops_cache_lifecycle(self, monkeypatch):
+        """The gathered slab operands are built once per pinned case set
+        (forced mode dispatches from the cache), reused by identity on
+        repeat dispatches, and evicted WITH the pre-cache."""
+        import cyclonus_tpu.engine.pallas_kernel as pk
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        monkeypatch.setattr(pk, "SLAB_BS", 8)
+        monkeypatch.setattr(pk, "SLAB_BD", 8)
+        monkeypatch.setattr(pk, "SLAB_W", 8)
+        policy, pods, namespaces = fuzz_problem(41, n_extra_pods=8)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        want = engine.evaluate_grid_counts(CASES, backend="xla")
+        for _ in range(3):
+            assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        # steady state + forced choice => the dispatch rides the cache
+        assert engine._slab_choice is True
+        assert engine._slab_ops_cache is not None
+        ops_first = engine._slab_ops_cache[1]
+        assert engine.evaluate_grid_counts(CASES, backend="pallas") == want
+        assert engine._slab_ops_cache[1] is ops_first  # reused, not rebuilt
+        # two consecutive other-set evaluations evict pre AND slab ops
+        other = [PortCase(9999, "", "TCP")]
+        want_other = engine.evaluate_grid_counts(other, backend="xla")
+        assert engine.evaluate_grid_counts(other, backend="pallas") == want_other
+        assert engine.evaluate_grid_counts(other, backend="pallas") == want_other
+        assert engine._slab_ops_cache is None or (
+            engine._slab_ops_cache[0] != engine._steady_state_args(CASES)[0]
+        )
 
     def test_counts_pipelined_eval(self):
         """counts_pipelined_eval_s: None before the pinned-precompute
