@@ -897,7 +897,8 @@ class TpuPolicyEngine:
         # upper gate: the slabs are materialized [q, n_tiles, w, N] HBM
         # copies (see verdict_counts_pallas_slab's design note); past
         # ~150k pods their bytes explode quadratically-in-tiles and the
-        # chunked kernels win.  Budget both directions at 2 port cases.
+        # chunked kernels win.  Budget both directions at 2 port cases
+        # (at the widest ladder rung; a narrower chosen w only shrinks).
         n_tiles = -(-n_b // SLAB_BS) + -(-n_b // SLAB_BD)
         # slab_w_aug: the kernel augments each window with the OR-term
         # row and pads to the dtype sublane tile
@@ -923,7 +924,18 @@ class TpuPolicyEngine:
         else:
             selpod = _selector_pod_matches_host(self._tensors)
         pod_ns = self._tensors["pod_ns_id"]
+        # adaptive window width: the kernel is MXU-MAC-bound (r5
+        # triangulation), so contract over the NARROWEST ladder rung
+        # whose windows cover every tile's band in both directions —
+        # target bands at the bench shape are ~5-10 rows, far below the
+        # conservative SLAB_W.  Wider-w correctness is monotone (rows
+        # outside a tile's band are zero for its columns), so one shared
+        # w = the max of the two directions' smallest fits.
+        # rungs never exceed the configured SLAB_W ceiling (tests set it
+        # low to drive the gate-rejection path)
+        ladder = sorted({max(1, SLAB_W // 4), max(1, SLAB_W // 2), SLAB_W})
         plan = {}
+        w_need = ladder[0]
         for direction, tile in (("egress", SLAB_BS), ("ingress", SLAB_BD)):
             d = self._tensors[direction]
             tm = d["target_ns"][:, None] == pod_ns[None, :]
@@ -932,10 +944,16 @@ class TpuPolicyEngine:
                 tm &= selpod[t_sel]
             tm = tm[:, perm]
             tm[:, n:] = False  # pads sort last; mirrors the kernel's mask
-            t0, ok = slab_windows(tm, tile, SLAB_W)
+            t0 = ok = None
+            for w_try in ladder:
+                t0, ok = slab_windows(tm, tile, w_try)
+                if ok:
+                    w_need = max(w_need, w_try)
+                    break
             if not ok:
                 return None
             plan[direction] = jax.device_put(t0)
+        plan["w"] = w_need
         if mode == "1":
             # forced mode skips the autotune; set the choice only now
             # that the plan is actually accepted (a stale True with no
@@ -1165,15 +1183,15 @@ class TpuPolicyEngine:
         )
         self._counts_from_pre_jit = jax.jit(counts_from_pre)
 
-        def slab_ops(pre, n_pods, t0_e, t0_i):
+        def slab_ops(pre, n_pods, t0_e, t0_i, w=None):
             e, ig = pre["egress"], pre["ingress"]
             return slab_operands(
                 e["tmatch"], e["has_target"], e["tallow_bf"],
                 ig["tmatch"], ig["has_target"], ig["tallow_bf"],
-                t0_e, t0_i, n_pods,
+                t0_e, t0_i, n_pods, w=w,
             )
 
-        self._slab_ops_jit = jax.jit(slab_ops)
+        self._slab_ops_jit = jax.jit(slab_ops, static_argnames=("w",))
         self._counts_from_slab_ops_jit = jax.jit(
             lambda ops: verdict_counts_pallas_slab_from_ops(
                 ops, interpret=interpret
@@ -1330,7 +1348,8 @@ class TpuPolicyEngine:
         slab = self._slab_plan_state
         n32 = np.int32(self.encoding.cluster.n_pods)
         ops = self._slab_ops_jit(
-            self._pre_cache[1], n32, slab["egress"], slab["ingress"]
+            self._pre_cache[1], n32, slab["egress"], slab["ingress"],
+            w=slab.get("w"),
         )
         if self._slab_choice is False:
             # an abandoned autotune candidate's thread can land here
