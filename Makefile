@@ -4,9 +4,19 @@
 test:
 	python -m pytest tests/ -q
 
+# static lint: ruff (when installed) + the JAX hot-path lint over the
+# engine package (tools/jaxlint.py — device-sync / traced-branch /
+# recompile-risk checks; see docs/DESIGN.md)
+lint:
+	@if python -m ruff --version >/dev/null 2>&1; then \
+	  python -m ruff check cyclonus_tpu tools bench.py; \
+	else echo "ruff not installed; skipping"; fi
+	python tools/jaxlint.py cyclonus_tpu/engine
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
-# syntax-compile everything, then run the suite on a CPU 8-device mesh
-check: vet
+# syntax-compile everything, lint the hot paths, then run the suite on a
+# CPU 8-device mesh
+check: vet lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -34,4 +44,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz bench fmt vet cyclonus docker
+.PHONY: test check conformance fuzz bench fmt vet lint cyclonus docker

@@ -15,7 +15,16 @@ from ..matcher.explain import explain_table
 from ..utils.table import render_table
 from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
 
-ALL_MODES = ["parse", "explain", "lint", "query-target", "query-traffic", "probe"]
+ALL_MODES = [
+    "parse",
+    "explain",
+    "lint",
+    "audit",
+    "diff",
+    "query-target",
+    "query-traffic",
+    "probe",
+]
 
 
 def setup_analyze(sub) -> None:
@@ -56,6 +65,17 @@ def setup_analyze(sub) -> None:
         default=True,
         action=_bool_action(),
         help="reduce policies to simpler form while preserving semantics",
+    )
+    cmd.add_argument(
+        "--policy-path2",
+        default="",
+        help="second policy file/directory for diff mode (set B)",
+    )
+    cmd.add_argument(
+        "--max-diff-cells",
+        type=int,
+        default=32,
+        help="max differing cells to print in diff mode",
     )
     cmd.add_argument("--target-pod-path", default="", help="json target pod file")
     cmd.add_argument("--traffic-path", default="", help="json traffic file")
@@ -140,6 +160,10 @@ def run_analyze(args) -> int:
             from ..linter import lint, warnings_table
 
             print(warnings_table(lint(kube_policies)))
+        elif mode == "audit":
+            _run_audit(policies, args)
+        elif mode == "diff":
+            _run_diff(policies, args)
         elif mode == "query-target":
             _query_targets(policies, args.target_pod_path, kube_pods)
         elif mode == "query-traffic":
@@ -151,6 +175,88 @@ def run_analyze(args) -> int:
         else:
             raise ValueError(f"unrecognized mode {mode}")
     return 0
+
+
+def _analysis_cluster(args, *policies):
+    """(pods, namespaces) for the audit/diff modes: the --probe-path
+    Resources model when given, else a representative cluster
+    synthesized from the policies themselves (analysis.cluster)."""
+    if args.probe_path:
+        with open(args.probe_path) as f:
+            config = json.load(f)
+        resources = (config.get("Resources") or config) or {}
+        pods = [
+            (
+                p["Namespace"],
+                p["Name"],
+                p.get("Labels") or {},
+                p.get("IP", "") or f"10.99.{i // 256}.{i % 256}",
+            )
+            for i, p in enumerate(resources.get("Pods") or [])
+        ]
+        namespaces = dict(resources.get("Namespaces") or {})
+        for ns, _, _, _ in pods:
+            namespaces.setdefault(ns, {})
+        if pods:
+            return pods, namespaces
+    from ..analysis import synthesize_cluster
+
+    return synthesize_cluster(*policies)
+
+
+def _run_audit(policies: Policy, args) -> None:
+    """`analyze --mode audit`: shadowed / never-firing resolved rules on
+    the dense encoding, oracle cross-checked (analysis.audit)."""
+    from ..analysis import audit_policy_set, derive_port_cases
+
+    pods, namespaces = _analysis_cluster(args, policies)
+    cases = derive_port_cases(policies)
+    report = audit_policy_set(policies, pods, namespaces, cases)
+    n_rules = sum(report.n_rules.values())
+    print(
+        f"audited {n_rules} resolved rules over {report.n_pods} pods x "
+        f"{len(report.cases)} port cases ({report.cells} grid cells), "
+        f"{report.oracle_checked} findings oracle-checked"
+    )
+    if not report.findings:
+        print("no dead rules: every rule fires uniquely somewhere")
+        return
+    print(report.table())
+
+
+def _run_diff(policies: Policy, args) -> None:
+    """`analyze --mode diff`: verdict-tensor diff of this policy set
+    (A) against --policy-path2 (B) on a shared cluster
+    (analysis.diff)."""
+    from ..analysis import derive_port_cases, diff_policy_sets
+
+    if not args.policy_path2:
+        raise ValueError("diff mode needs --policy-path2 (the B policy set)")
+    kube_b = load_policies_from_path(args.policy_path2)
+    policies_b = build_network_policies(args.simplify_policies, kube_b)
+    pods, namespaces = _analysis_cluster(args, policies, policies_b)
+    cases = derive_port_cases(policies, policies_b)
+    report = diff_policy_sets(
+        policies, policies_b, pods, namespaces, cases,
+        max_cells=args.max_diff_cells,
+    )
+    if report.equivalent:
+        print(
+            f"policy sets EQUIVALENT: 0 of {report.total_cells} verdict "
+            f"cells differ ({len(report.pod_keys)} pods x "
+            f"{len(report.cases)} port cases; "
+            f"{report.oracle_checked} cells oracle-checked)"
+        )
+        return
+    print(
+        f"policy sets DIFFER: "
+        + ", ".join(f"{k}={v}" for k, v in report.n_diff.items())
+        + f" of {report.total_cells} verdict cells "
+        f"({report.oracle_checked} cells oracle-checked)"
+    )
+    print(report.table())
+    if report.truncated:
+        print(f"... truncated to the first {len(report.cells)} cells")
 
 
 def _print_peers(peers) -> str:

@@ -9,6 +9,7 @@ the whole pod x pod x port-case verdict grid on device.
 from __future__ import annotations
 
 import ipaddress
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -638,6 +639,10 @@ class TpuPolicyEngine:
         # — the abandoned candidate thread's completion marker; dispatches
         # gate on it (_drain_autotune_orphan)
         self._autotune_orphan = None
+        # guards the (_slab_choice, _slab_ops_cache) pair: the autotune's
+        # rejection writes and the ops-cache fill can race an abandoned
+        # candidate thread still inside _slab_ops_for
+        self._slab_lock = threading.Lock()
         self._counts_packed_jit = None
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
@@ -874,6 +879,7 @@ class TpuPolicyEngine:
             SLAB_BD,
             SLAB_BS,
             SLAB_W,
+            _resolve_operand_dtype,
             slab_w_aug,
             slab_windows,
         )
@@ -901,8 +907,12 @@ class TpuPolicyEngine:
         # (at the widest ladder rung; a narrower chosen w only shrinks).
         n_tiles = -(-n_b // SLAB_BS) + -(-n_b // SLAB_BD)
         # slab_w_aug: the kernel augments each window with the OR-term
-        # row and pads to the dtype sublane tile
-        bytes_per_case = n_tiles * slab_w_aug() * n_b
+        # row and pads to the dtype sublane tile.  The slabs materialize
+        # in the OPERAND dtype, so the budget is elements * itemsize —
+        # counting elements as bytes let bf16 slabs blow 2x past
+        # CYCLONUS_SLAB_MAX_BYTES
+        itemsize = 2 if _resolve_operand_dtype(None) == "bf16" else 1
+        bytes_per_case = n_tiles * slab_w_aug() * n_b * itemsize
         budget = int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
         if 2 * bytes_per_case > budget:
             return None
@@ -1060,14 +1070,17 @@ class TpuPolicyEngine:
         status, value = run_bounded(candidate, timeout_s)
         if status != "ok":
             cancelled["v"] = True
-            # a half-built ops cache from the abandoned thread must not
-            # feed later dispatches of a rejected kernel
-            self._slab_ops_cache = None
             # compile/run failure or timeout: the candidate rejects
             # itself — it must never take down the proven default path
             # (this autotune is the only place the slab program runs
-            # unforced, so the failure is contained here)
-            self._slab_choice = False
+            # unforced, so the failure is contained here).  Rejection and
+            # cache clear happen atomically under _slab_lock: the
+            # abandoned thread may still be inside _slab_ops_for, and an
+            # unguarded clear here could be overwritten by its cache
+            # fill, re-pinning slab HBM for a rejected kernel
+            with self._slab_lock:
+                self._slab_choice = False
+                self._slab_ops_cache = None
             # the rejection is telemetry too: BENCH detail must show WHY
             # there are no timed legs, and whether the abandoned thread's
             # in-flight work later raced a real dispatch
@@ -1092,12 +1105,13 @@ class TpuPolicyEngine:
             )
             return out_default
         t_slab, out_slab = value
-        self._slab_choice = bool(t_slab < 0.9 * t_default)
-        if not self._slab_choice:
-            # a timing-rejected slab never dispatches again: its cached
-            # operands (up to the slab byte budget of HBM) must not stay
-            # pinned next to the precompute
-            self._slab_ops_cache = None
+        with self._slab_lock:
+            self._slab_choice = bool(t_slab < 0.9 * t_default)
+            if not self._slab_choice:
+                # a timing-rejected slab never dispatches again: its
+                # cached operands (up to the slab byte budget of HBM)
+                # must not stay pinned next to the precompute
+                self._slab_ops_cache = None
         self._slab_autotune = {
             "default_s": round(t_default, 4),
             "slab_s": round(t_slab, 4),
@@ -1351,12 +1365,14 @@ class TpuPolicyEngine:
             self._pre_cache[1], n32, slab["egress"], slab["ingress"],
             w=slab.get("w"),
         )
-        if self._slab_choice is False:
-            # an abandoned autotune candidate's thread can land here
-            # AFTER the main thread rejected the slab and cleared the
-            # cache — a rejected kernel's operands must not re-pin HBM
-            return ops
-        self._slab_ops_cache = (key, ops)
+        # check-and-fill under the SAME lock as the autotune's rejection
+        # writes: without it an abandoned candidate thread can pass the
+        # choice check, lose the CPU to the main thread's rejection +
+        # cache clear, then re-pin slab HBM for the rejected kernel
+        with self._slab_lock:
+            if self._slab_choice is False:
+                return ops
+            self._slab_ops_cache = (key, ops)
         return ops
 
     def _dispatch_steady(self, key, slab_args):
@@ -1496,6 +1512,36 @@ class TpuPolicyEngine:
             ],
             axis=2,
         )
+
+    def firing_components(
+        self, cases: Sequence[PortCase]
+    ) -> Dict[str, Dict[str, np.ndarray]]:
+        """Per-direction RULE firing-mask components on the RAW encoding
+        (no dead-target compaction, no shape bucketing), so flat peer row
+        p maps 1:1 to resolved rule (peer_target[p], peer_rule_idx[p]) of
+        the policy's sorted_targets() order — the contract the analysis
+        subsystem (cyclonus_tpu.analysis) audits on.
+
+        Returns {direction: {rule_tmatch [P, N], peer_match [P, N],
+        pport [P, Q], has_target [N]}} numpy bool arrays; rule p's firing
+        mask over (target-side pod n, peer-side pod m, case q) is
+        rule_tmatch[p, n] & peer_match[p, m] & pport[p, q]."""
+        from .kernel import rule_firing_kernel
+
+        self._check_ips()
+        raw = self._build_tensors()
+        q_port, q_name, q_proto = self._port_case_arrays(cases)
+        shared = {
+            k: v for k, v in raw.items() if k not in ("ingress", "egress")
+        }
+        shared["q_port"] = q_port
+        shared["q_name"] = q_name
+        shared["q_proto"] = q_proto
+        out = {}
+        for direction in ("ingress", "egress"):
+            comp = rule_firing_kernel(shared, raw[direction])
+            out[direction] = {k: np.asarray(v) for k, v in comp.items()}
+        return out
 
     def evaluate_grid_sharded(
         self, cases: Sequence[PortCase], mesh=None

@@ -458,6 +458,9 @@ class _DirectionEncoding:
     target_sel: np.ndarray  # [T] int32 selector id
     # peers, flat:
     peer_target: np.ndarray  # [P] int32
+    peer_rule_idx: np.ndarray  # [P] int32: peer's index WITHIN its target
+    # (rule provenance for the analysis layer: flat row p is rule
+    # (peer_target[p], peer_rule_idx[p]) of the sorted_targets() order)
     peer_kind: np.ndarray  # [P] int32
     peer_ns_kind: np.ndarray  # [P] int32 (pod peers)
     peer_ns_id: np.ndarray  # [P] int32 (NS_EXACT)
@@ -487,7 +490,7 @@ def _encode_direction(
     targets, sel_table: _SelectorTable, vocab: _Vocab
 ) -> _DirectionEncoding:
     t_ns, t_sel = [], []
-    p_target, p_kind = [], []
+    p_target, p_rule_idx, p_kind = [], [], []
     p_ns_kind, p_ns_id, p_ns_sel = [], [], []
     p_pod_kind, p_pod_sel = [], []
     ip_rows: List[Tuple[int, int, bool]] = []  # (base, mask, is_v4)
@@ -501,8 +504,9 @@ def _encode_direction(
         # equality against pod ns ids is well-defined either way.
         t_ns.append(vocab.ns_id(target.namespace))
         t_sel.append(sel_table.sel_id(target.pod_selector))
-        for peer in target.peers:
+        for peer_idx, peer in enumerate(target.peers):
             p_target.append(t_idx)
+            p_rule_idx.append(peer_idx)
             if isinstance(peer, AllPeersMatcher):
                 p_kind.append(PEER_ALL)
                 specs.add(AllPortMatcher(), vocab)
@@ -607,6 +611,7 @@ def _encode_direction(
         target_ns=np.array(t_ns, dtype=np.int32).reshape(-1),
         target_sel=np.array(t_sel, dtype=np.int32).reshape(-1),
         peer_target=np.array(p_target, dtype=np.int32).reshape(-1),
+        peer_rule_idx=np.array(p_rule_idx, dtype=np.int32).reshape(-1),
         peer_kind=np.array(p_kind, dtype=np.int32).reshape(-1),
         peer_ns_kind=np.array(p_ns_kind, dtype=np.int32).reshape(-1),
         peer_ns_id=np.array(p_ns_id, dtype=np.int32).reshape(-1),

@@ -295,6 +295,68 @@ def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
 
 
 @jax.jit
+def rule_firing_kernel(shared: Dict, enc: Dict) -> Dict[str, jnp.ndarray]:
+    """Per-RULE firing-mask components for one direction — the batched
+    variant of the verdict path that the analysis layer
+    (cyclonus_tpu.analysis) audits on.
+
+    The firing mask of flat peer rule p over (target-side pod n,
+    peer-side pod m, port case q) is the rank-1 product
+
+        fire[p, n, m, q] = rule_tmatch[p, n] & peer_match[p, m] & pport[p, q]
+
+    so returning the three factors is the whole mask without ever
+    materializing [P, N, N, Q].  rule_tmatch gathers each rule's
+    target row (a rule fires only where its OWN target applies), with
+    pad rules (peer_target -1) masked to all-False."""
+    selpod = selector_match(
+        shared["sel_req_kv"],
+        shared["sel_exp_op"],
+        shared["sel_exp_key"],
+        shared["sel_exp_vals"],
+        shared["pod_kv"],
+        shared["pod_key"],
+    )
+    selns = selector_match(
+        shared["sel_req_kv"],
+        shared["sel_exp_op"],
+        shared["sel_exp_key"],
+        shared["sel_exp_vals"],
+        shared["ns_kv"],
+        shared["ns_key"],
+    )
+    pre = direction_precompute(
+        enc,
+        selpod,
+        selns,
+        shared["pod_ns_id"],
+        shared["pod_ip"],
+        shared["pod_ip_valid"],
+    )
+    peer_match = pre["peer_match"]
+    if "host_ip_match" in enc:
+        peer_match = jnp.where(
+            enc["host_ip_mask"][:, None], enc["host_ip_match"], peer_match
+        )
+    pport = port_spec_allows(
+        enc["port_spec"],
+        shared["q_port"],
+        shared["q_name"],
+        shared["q_proto"],
+    )
+    pt = enc["peer_target"]
+    rule_tmatch = jnp.take(pre["tmatch"], jnp.maximum(pt, 0), axis=0) & (
+        pt >= 0
+    )[:, None]
+    return {
+        "rule_tmatch": rule_tmatch,  # [P, N] bool
+        "peer_match": peer_match,  # [P, N] bool
+        "pport": pport,  # [P, Q] bool
+        "has_target": pre["has_target"],  # [N] bool
+    }
+
+
+@jax.jit
 def grid_stats_kernel(ingress, egress, combined) -> jnp.ndarray:
     """[3] f32 mean allow-rates — one execution, one scalar-sized
     transfer (vs three separate float() readbacks)."""

@@ -640,14 +640,18 @@ SLAB_BD = 1024
 
 def slab_w_aug(operand_dtype: str = None, w: int = None) -> int:
     """Augmented window depth the slab kernel actually materializes:
-    the w-row window + the pseudo/validity OR-term row, padded to the
-    operand dtype's native sublane tile (int8: 32, bf16: 16).  The ONE
-    source of truth — the engine's HBM budget (api._slab_plan) must use
-    this, not re-derive it."""
+    the w-row window + the pseudo/validity OR-term row, ROUNDED UP to
+    the operand dtype's native sublane tile (int8: 32, bf16: 16).  The
+    ceil keeps the alignment property for ARBITRARY w overrides (the
+    old `w + tile` form only aligned when w itself was tile-aligned);
+    for tile-aligned w the two forms agree, so the default layout is
+    unchanged.  The ONE source of truth — the engine's HBM budget
+    (api._slab_plan) must use this, not re-derive it."""
     if w is None:
         w = SLAB_W
     od = _resolve_operand_dtype(operand_dtype)
-    return w + (32 if od == "int8" else 16)
+    tile = 32 if od == "int8" else 16
+    return -(-(w + 1) // tile) * tile
 
 
 def slab_windows(tmatch: "np.ndarray", tile: int, w: int = SLAB_W):

@@ -1,0 +1,338 @@
+"""Analysis-subsystem tests: rule firing masks, shadowing/redundancy
+audit, policy-set diff/equivalence, and the scalar-oracle cross-checks —
+the acceptance gates of the audit tentpole:
+
+  * a hand-built shadowed rule is flagged (and its coverers named);
+  * diff of a policy set against itself is empty;
+  * diff against a one-rule perturbation localizes the changed cells;
+  * every claim survives the oracle cross-check on all examples.py
+    fixtures (audit_policy_set raises on a refuted claim).
+"""
+
+import numpy as np
+import pytest
+
+from cyclonus_tpu.analysis import (
+    audit_policy_set,
+    derive_port_cases,
+    diff_policy_sets,
+    policy_without_rule,
+    synthesize_cluster,
+)
+from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
+from cyclonus_tpu.kube.examples import all_examples
+from cyclonus_tpu.kube.netpol import (
+    IntOrString,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.kube.pathological import (
+    ALLOW_ALL_INGRESS,
+    ALLOW_MATCHING_PODS_IN_POLICY_NAMESPACE_PEER,
+    LABELS_CD,
+    NAMESPACE,
+    SELECTOR_EMPTY,
+    SELECTOR_GH,
+)
+from cyclonus_tpu.matcher import build_network_policies
+
+CASES = [PortCase(80, "", "TCP"), PortCase(53, "", "UDP")]
+
+
+def _pathological_cluster():
+    pods = [
+        (NAMESPACE, "plain", {}, "10.0.1.1"),
+        (NAMESPACE, "cd", dict(LABELS_CD), "10.0.1.2"),
+        ("other", "out", {}, "10.0.2.1"),
+    ]
+    namespaces = {NAMESPACE: {"ns": NAMESPACE}, "other": {"ns": "other"}}
+    return pods, namespaces
+
+
+def _ingress_policy(name, peers, ports=None, namespace=NAMESPACE,
+                    pod_selector=SELECTOR_EMPTY):
+    return NetworkPolicy(
+        name=name,
+        namespace=namespace,
+        spec=NetworkPolicySpec(
+            pod_selector=pod_selector,
+            policy_types=["Ingress"],
+            ingress=[NetworkPolicyIngressRule(from_=peers, ports=ports or [])],
+        ),
+    )
+
+
+class TestAudit:
+    def test_pathological_shadowed_rule(self):
+        """ALLOW_ALL_INGRESS (all peers) + a narrow pod-selector rule on
+        the same target: the narrow rule's firing mask is subsumed —
+        flagged shadowed, coverer + source policies named."""
+        narrow = _ingress_policy(
+            "narrow", [ALLOW_MATCHING_PODS_IN_POLICY_NAMESPACE_PEER]
+        )
+        policy = build_network_policies(False, [ALLOW_ALL_INGRESS, narrow])
+        pods, namespaces = _pathological_cluster()
+        report = audit_policy_set(policy, pods, namespaces, CASES)
+        shadowed = [f for f in report.findings if f.kind == "shadowed"]
+        assert len(shadowed) == 1
+        f = shadowed[0]
+        assert f.rule.direction == "ingress"
+        assert f.oracle == "confirmed"
+        assert f.fire_cells > 0
+        assert f.rule.policies == (f"{NAMESPACE}/narrow",)
+        # the all-peers rule covers it, and its source policy is named
+        assert any(
+            f"{NAMESPACE}/allow-all-ingress" in c.policies
+            for c in f.covered_by
+        )
+
+    def test_never_firing_rule(self):
+        """A peer selector matching no pod of the cluster never fires."""
+        dead = _ingress_policy(
+            "dead", [NetworkPolicyPeer(pod_selector=SELECTOR_GH)]
+        )
+        policy = build_network_policies(False, [dead])
+        pods, namespaces = _pathological_cluster()  # no {g: g} pod anywhere
+        report = audit_policy_set(policy, pods, namespaces, CASES)
+        assert [f.kind for f in report.findings] == ["never-fires"]
+        assert report.findings[0].oracle == "confirmed"
+
+    def test_live_rules_not_flagged(self):
+        """Two disjoint narrow rules both fire uniquely: no findings."""
+        a = _ingress_policy(
+            "only", [ALLOW_MATCHING_PODS_IN_POLICY_NAMESPACE_PEER]
+        )
+        policy = build_network_policies(False, [a])
+        pods, namespaces = _pathological_cluster()
+        report = audit_policy_set(policy, pods, namespaces, CASES)
+        assert report.findings == []
+        assert report.n_rules["ingress"] == 1
+
+    def test_port_shadowing(self):
+        """Same peer twice — all ports vs port 80 only: the port-80 rule
+        is shadowed (every cell it fires on, the all-port rule fires)."""
+        wide = _ingress_policy("wide", [NetworkPolicyPeer()])
+        narrow = _ingress_policy(
+            "narrow-port",
+            [NetworkPolicyPeer()],
+            ports=[NetworkPolicyPort(protocol="TCP", port=IntOrString(80))],
+        )
+        policy = build_network_policies(False, [wide, narrow])
+        pods, namespaces = _pathological_cluster()
+        report = audit_policy_set(policy, pods, namespaces, CASES)
+        shadowed = [f for f in report.findings if f.kind == "shadowed"]
+        assert len(shadowed) == 1
+        assert "narrow-port" in shadowed[0].rule.policies[0]
+
+    def test_examples_fixtures_oracle_checked(self):
+        """Every examples.py fixture audits clean through the oracle
+        cross-check (audit_policy_set raises on any refuted claim)."""
+        policy = build_network_policies(False, all_examples())
+        pods, namespaces = synthesize_cluster(policy)
+        cases = derive_port_cases(policy)
+        report = audit_policy_set(
+            policy, pods, namespaces, cases, oracle_samples=4
+        )
+        assert report.oracle_checked == sum(
+            1 for f in report.findings if f.oracle == "confirmed"
+        )
+        assert all(
+            f.oracle == "confirmed" for f in report.findings
+        ), report.table()
+        assert sum(report.n_rules.values()) > 10
+
+    def test_firing_components_reconstruct_grid(self):
+        """The rank-1 firing-mask factors reconstruct the direction
+        verdicts exactly: allowed = ~has_target | OR_p fire[p]."""
+        policy = build_network_policies(False, all_examples()[:8])
+        pods, namespaces = synthesize_cluster(policy)
+        cases = derive_port_cases(policy)[:3]
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        comp = engine.firing_components(cases)
+        grid = engine.evaluate_grid(cases)
+        n = len(pods)
+        for direction, got in (
+            ("ingress", np.swapaxes(np.asarray(grid.ingress), 1, 2)),
+            ("egress", np.asarray(grid.egress)),
+        ):
+            c = comp[direction]
+            a, b, cq = c["rule_tmatch"], c["peer_match"], c["pport"]
+            # fire[p, n, m, q] -> any over p; n = target side, m = peer side
+            fire_any = np.einsum("pn,pm,pq->nmq", a, b, cq) > 0
+            allowed = (~c["has_target"][:, None, None]) | fire_any
+            if direction == "ingress":
+                # target side is the DESTINATION: [dst, src, q] -> [q, src, dst]
+                want = np.moveaxis(allowed, -1, 0).swapaxes(1, 2)
+            else:
+                want = np.moveaxis(allowed, -1, 0)
+            np.testing.assert_array_equal(want, got, err_msg=direction)
+
+    def test_audit_grid_cap(self):
+        policy = build_network_policies(False, [ALLOW_ALL_INGRESS])
+        pods = [(NAMESPACE, f"p{i}", {}, f"10.0.0.{i}") for i in range(4)]
+        with pytest.raises(ValueError, match="exceeds"):
+            audit_policy_set(
+                policy, pods * 3000, {NAMESPACE: {}}, CASES
+            )
+
+
+class TestDiff:
+    def test_self_diff_empty(self):
+        policy = build_network_policies(False, all_examples())
+        pods, namespaces = synthesize_cluster(policy)
+        cases = derive_port_cases(policy)
+        report = diff_policy_sets(policy, policy, pods, namespaces, cases)
+        assert report.equivalent
+        assert report.n_diff == {"ingress": 0, "egress": 0, "combined": 0}
+        assert report.cells == []
+        assert report.oracle_checked > 0
+
+    def test_one_rule_perturbation_localizes(self):
+        """Removing the single live ingress rule of the app=web target
+        flips exactly the cells into that target's pods — diff reports
+        them, nothing else, and egress never differs."""
+        web = LabelSelector.make(match_labels={"app": "web"})
+        client = NetworkPolicyPeer(
+            pod_selector=LabelSelector.make(match_labels={"app": "client"})
+        )
+        pol = _ingress_policy(
+            "web-in", [client], namespace="default", pod_selector=web
+        )
+        policy_a = build_network_policies(False, [pol])
+        # perturbation: strip ingress rule (t0, r0) -> deny-all target
+        policy_b = policy_without_rule(policy_a, "ingress", 0, 0)
+        pods = [
+            ("default", "web", {"app": "web"}, "10.0.0.1"),
+            ("default", "client", {"app": "client"}, "10.0.0.2"),
+            ("default", "other", {}, "10.0.0.3"),
+        ]
+        namespaces = {"default": {}}
+        report = diff_policy_sets(
+            policy_a, policy_b, pods, namespaces, CASES
+        )
+        assert not report.equivalent
+        assert report.n_diff["egress"] == 0
+        assert report.n_diff["ingress"] > 0
+        assert len(report.cells) == report.n_diff["ingress"]
+        # every differing cell lands on the perturbed target's pod, and
+        # only where the removed rule fired (src=client)
+        for cell in report.cells:
+            assert cell.dst == "default/web"
+            assert cell.src == "default/client"
+            assert cell.a[0] and not cell.b[0]  # ingress allowed -> denied
+
+    def test_diff_oracle_samples_cover_both_sides(self):
+        policy_a = build_network_policies(False, [ALLOW_ALL_INGRESS])
+        policy_b = build_network_policies(False, [])
+        pods, namespaces = _pathological_cluster()
+        report = diff_policy_sets(policy_a, policy_b, pods, namespaces, CASES)
+        # allow-all vs no-policy: both all-allow -> equivalent grids
+        assert report.equivalent
+
+
+SHADOW_YAML = """\
+apiVersion: networking.k8s.io/v1
+kind: NetworkPolicy
+metadata:
+  name: web-allow
+  namespace: default
+spec:
+  podSelector: {}
+  policyTypes: ["Ingress"]
+  ingress:
+    - from:
+        - podSelector: {}
+    - from:
+        - podSelector:
+            matchLabels:
+              app: web
+      ports:
+        - protocol: TCP
+          port: 80
+"""
+
+
+class TestCli:
+    def _run(self, capsys, argv):
+        from cyclonus_tpu.cli.root import main
+
+        rc = main(argv)
+        out = capsys.readouterr().out
+        return rc, out
+
+    def test_audit_flags_shadowed_rule(self, tmp_path, capsys):
+        p = tmp_path / "shadow.yaml"
+        p.write_text(SHADOW_YAML)
+        rc, out = self._run(
+            capsys,
+            ["analyze", "--mode", "audit", "--policy-path", str(p),
+             "--simplify-policies", "false"],
+        )
+        assert rc == 0
+        assert "shadowed" in out
+        assert "default/web-allow" in out
+        assert "confirmed" in out
+
+    def test_diff_identical_sets_zero_cells(self, tmp_path, capsys):
+        p = tmp_path / "shadow.yaml"
+        p.write_text(SHADOW_YAML)
+        rc, out = self._run(
+            capsys,
+            ["analyze", "--mode", "diff", "--policy-path", str(p),
+             "--policy-path2", str(p), "--simplify-policies", "false"],
+        )
+        assert rc == 0
+        assert "EQUIVALENT: 0 of" in out
+
+    def test_diff_perturbed_set_reports_cells(self, tmp_path, capsys):
+        a = tmp_path / "a.yaml"
+        a.write_text(SHADOW_YAML)
+        b = tmp_path / "b.yaml"
+        # drop the broad allow-all rule: verdicts must differ
+        b.write_text(
+            SHADOW_YAML.replace(
+                "    - from:\n        - podSelector: {}\n", "", 1
+            )
+        )
+        rc, out = self._run(
+            capsys,
+            ["analyze", "--mode", "diff", "--policy-path", str(a),
+             "--policy-path2", str(b), "--simplify-policies", "false"],
+        )
+        assert rc == 0
+        assert "DIFFER" in out
+        assert "oracle-checked" in out
+
+
+class TestInputs:
+    def test_derive_port_cases(self):
+        pol = _ingress_policy(
+            "ports",
+            [NetworkPolicyPeer()],
+            ports=[
+                NetworkPolicyPort(protocol="TCP", port=IntOrString(8080)),
+                NetworkPolicyPort(protocol="UDP", port=IntOrString("dns")),
+            ],
+        )
+        policy = build_network_policies(False, [pol])
+        cases = derive_port_cases(policy)
+        assert PortCase(8080, "", "TCP") in cases
+        assert PortCase(0, "dns", "UDP") in cases
+        assert PortCase(80, "", "TCP") in cases  # baseline
+        assert any(c.port == 65432 for c in cases)  # sentinel
+        assert len(cases) == len(set(cases))
+
+    def test_synthesize_cluster_covers_selectors(self):
+        policy = build_network_policies(False, all_examples())
+        pods, namespaces = synthesize_cluster(policy)
+        assert pods and namespaces
+        assert len(pods) <= 48
+        # every pod namespace exists in the namespace map
+        assert {p[0] for p in pods} <= set(namespaces)
+        # distinct IPs
+        ips = [p[3] for p in pods]
+        assert len(ips) == len(set(ips))

@@ -821,3 +821,77 @@ class TestPallasCounts:
         assert got == want
         n = len(pods)
         assert got["egress"] == n * n * len(CASES)  # no egress targets
+
+
+class TestSlabLayout:
+    def test_slab_w_aug_alignment_arbitrary_w(self):
+        """slab_w_aug must land on the dtype sublane tile for ANY w
+        override (not just tile-aligned ones), with room for the window
+        plus the OR-term row."""
+        from cyclonus_tpu.engine.pallas_kernel import slab_w_aug
+
+        for od, tile in (("int8", 32), ("bf16", 16)):
+            for w in (1, 17, 32, 100, 128, 129, 257):
+                aug = slab_w_aug(od, w)
+                assert aug % tile == 0, (od, w, aug)
+                assert aug >= w + 1, (od, w, aug)
+                # minimal: no more than one extra tile of padding
+                assert aug < w + 1 + tile, (od, w, aug)
+
+    def test_slab_w_aug_default_unchanged(self):
+        """Tile-aligned defaults keep the historical layout (the
+        persistent compile cache keys on these shapes)."""
+        from cyclonus_tpu.engine.pallas_kernel import SLAB_W, slab_w_aug
+
+        assert SLAB_W % 32 == 0
+        assert slab_w_aug("int8") == SLAB_W + 32
+        assert slab_w_aug("bf16") == SLAB_W + 16
+
+    def test_slab_budget_counts_bytes_not_elements(self, monkeypatch):
+        """api._slab_plan must scale its HBM estimate by the operand
+        itemsize: with bf16 operands the same element count is twice
+        the bytes, so a budget that admits an int8 plan at the edge
+        must reject the bf16 one."""
+        from cyclonus_tpu.engine.pallas_kernel import (
+            SLAB_BD,
+            SLAB_BS,
+            slab_w_aug,
+        )
+        from cyclonus_tpu.matcher import build_network_policies
+        from test_engine_parity import mkpol
+        from cyclonus_tpu.kube.netpol import (
+            LabelSelector,
+            NetworkPolicyIngressRule,
+        )
+
+        n = 4 * SLAB_BS  # spans >= 2 src tiles so the plan engages
+        pods = [("x", f"p{i}", {"pod": "a"}, f"10.0.{i // 250}.{i % 250}")
+                for i in range(n)]
+        namespaces = {"x": {"ns": "x"}}
+        policy = build_network_policies(
+            True,
+            [mkpol("allow", "x", LabelSelector.make(), ["Ingress"],
+                   ingress=[NetworkPolicyIngressRule()])],
+        )
+        monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+
+        monkeypatch.setenv("CYCLONUS_PALLAS_DTYPE", "int8")
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        n_b = int(engine._tensors["pod_ns_id"].shape[0])
+        n_tiles = -(-n_b // SLAB_BS) + -(-n_b // SLAB_BD)
+        elems = n_tiles * slab_w_aug("int8") * n_b
+        ns = engine._tensors["pod_ns_id"]
+        key = np.where(ns < 0, np.iinfo(np.int32).max, ns)
+        perm = np.argsort(key, kind="stable").astype(np.int32)
+
+        # budget admitting 2 cases of int8 exactly
+        budget = 2 * elems
+        monkeypatch.setenv("CYCLONUS_SLAB_MAX_BYTES", str(budget))
+        assert engine._slab_plan(perm) is not None
+
+        # same ELEMENT budget under bf16 must be rejected (2x the bytes)
+        monkeypatch.setenv("CYCLONUS_PALLAS_DTYPE", "bf16")
+        bf16_elems = n_tiles * slab_w_aug("bf16") * n_b
+        monkeypatch.setenv("CYCLONUS_SLAB_MAX_BYTES", str(2 * bf16_elems))
+        engine2 = TpuPolicyEngine(policy, pods, namespaces)
+        assert engine2._slab_plan(perm) is None

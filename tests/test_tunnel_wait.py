@@ -54,6 +54,18 @@ class TestRunBench:
         failed = out.replace(".json", ".failed.json")
         assert json.load(open(failed))["error"] == "tunnel dead"
 
+    def test_truncated_json_is_error_result(self, monkeypatch, tmp_path):
+        """A bench killed mid-write leaves a truncated final JSON line:
+        recorded as an error result, never a watchdog-killing raise."""
+        result, out = self._run(
+            monkeypatch, tmp_path, '{"metric": "m", "val', rc=2
+        )
+        assert "unparseable JSON" in result["error"]
+        assert result["bench_rc"] == 2
+        failed = out.replace(".json", ".failed.json")
+        assert json.load(open(failed))["bench_rc"] == 2
+        assert not os.path.exists(out)
+
     def test_no_json_output(self, monkeypatch, tmp_path):
         result, out = self._run(monkeypatch, tmp_path, "garbage only\n", rc=7)
         assert "no JSON" in result["error"]

@@ -1,0 +1,561 @@
+#!/usr/bin/env python
+"""JAX hot-path static lint: AST checks for the device-throughput defect
+classes that have repeatedly cost rounds 1-5 their kernel time.
+
+Scans jit-traced functions (decorated with jax.jit / partial(jax.jit),
+or passed to a jax.jit(...) call as a named function or lambda) and
+flags, via a per-function taint pass seeded from the traced parameters:
+
+  JX001  implicit device->host sync on a traced value: `.item()` /
+         `.tolist()`, `float()`/`int()`/`bool()` coercion, or any
+         `np.*` call fed a traced argument (np.asarray on a tracer is
+         the classic silent round trip)
+  JX002  Python control flow on a traced value: `if` / `while` /
+         ternary / `assert` whose condition depends on a tracer
+         (TracerBoolConversionError at best, silent concretization and
+         per-value recompilation at worst)
+  JX003  recompilation hazard: jit function with a mutable default
+         argument (dict/list/set) — a fresh object per call site makes
+         the static-argument cache key unstable
+  JX004  recompilation/staleness hazard: jit function closing over a
+         module-level array — the array is baked into the compiled
+         program as a constant; rebinding the global silently keeps the
+         stale weights
+
+`static_argnames` / `static_argnums` parameters are exempt from taint
+(branching on a static is the whole point of statics), as are shape /
+dtype attribute reads (`.shape`, `.ndim`, `.dtype`, `.size`,
+`.nbytes`), `is` / `in` tests, and isinstance/len/hasattr conditions.
+Nested defs inherit taint through their call sites when visible (a
+helper called only with static arguments stays static).
+
+Suppress a finding with `# jaxlint: ignore` or
+`# jaxlint: ignore[JX001,...]` on the offending line.
+
+Usage: python tools/jaxlint.py [paths...]   (default: cyclonus_tpu/engine)
+Exit status 1 iff findings remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
+COERCIONS = {"float", "int", "bool", "complex"}
+SYNC_METHODS = {"item", "tolist"}
+EXEMPT_CALLS = {"isinstance", "len", "hasattr", "callable", "getattr", "type"}
+MUTABLE_DEFAULTS = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)
+_IGNORE_RE = re.compile(r"#\s*jaxlint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """Base Name id of an attribute chain (jnp.foo.bar -> 'jnp')."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class ModuleInfo:
+    """Import aliases, module-level array globals, function defs by name."""
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: Dict[str, str] = {}  # local name -> module path
+        self.array_globals: Set[str] = set()
+        self.funcs: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(node.name, []).append(node)
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                root = _attr_root(stmt.value.func)
+                if root and self.module_kind(root) in ("numpy", "jax"):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.array_globals.add(t.id)
+
+    def module_kind(self, name: str) -> Optional[str]:
+        """'jax' / 'numpy' for names aliasing those module trees."""
+        path = self.aliases.get(name, "")
+        if path == "numpy" or path.startswith("numpy."):
+            return "numpy"
+        if path == "jax" or path.startswith("jax."):
+            return "jax"
+        return None
+
+
+def _is_jit_func_expr(info: ModuleInfo, node: ast.AST) -> bool:
+    """Does this expression denote jax.jit (or an alias of it)?"""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        root = _attr_root(node)
+        return root is not None and info.module_kind(root) == "jax"
+    if isinstance(node, ast.Name):
+        return info.aliases.get(node.id, "") in ("jax.jit", "jit")
+    return False
+
+
+def _static_names(call: Optional[ast.Call], func: ast.AST) -> Set[str]:
+    """Parameter names marked static via static_argnames/static_argnums."""
+    out: Set[str] = set()
+    if call is None:
+        return out
+    params: List[str] = []
+    if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = func.args
+        params = [x.arg for x in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    if 0 <= el.value < len(params):
+                        out.add(params[el.value])
+    return out
+
+
+def collect_jit_functions(
+    info: ModuleInfo, tree: ast.Module
+) -> List[Tuple[ast.AST, Set[str]]]:
+    """(function node, static param names) for every jit-traced function
+    discoverable in the module: decorated defs, jax.jit(named_func),
+    jax.jit(lambda ...)."""
+    out: List[Tuple[ast.AST, Set[str]]] = []
+    seen: Set[int] = set()
+
+    def add(node: ast.AST, statics: Set[str]) -> None:
+        if id(node) not in seen:
+            seen.add(id(node))
+            out.append((node, statics))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_func_expr(info, dec):
+                    add(node, set())
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_func_expr(info, dec.func):
+                        add(node, _static_names(dec, node))
+                    elif (
+                        _attr_root(dec.func) is not None
+                        and (
+                            info.aliases.get(_attr_root(dec.func), "")
+                            in ("functools.partial", "partial")
+                            or (
+                                isinstance(dec.func, ast.Attribute)
+                                and dec.func.attr == "partial"
+                            )
+                        )
+                        and dec.args
+                        and _is_jit_func_expr(info, dec.args[0])
+                    ):
+                        add(node, _static_names(dec, node))
+        elif isinstance(node, ast.Call) and _is_jit_func_expr(info, node.func):
+            if not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                add(target, _static_names(node, target))
+            elif isinstance(target, ast.Name):
+                for fn in info.funcs.get(target.id, []):
+                    add(fn, _static_names(node, fn))
+    return out
+
+
+class TaintChecker:
+    """Intra-function taint propagation + finding detection for ONE
+    jit-traced function.  Conservative by construction: unknown calls
+    with a tainted argument return taint; shape/dtype reads drop it."""
+
+    def __init__(self, info: ModuleInfo, path: str, func: ast.AST, statics: Set[str]):
+        self.info = info
+        self.path = path
+        self.func = func
+        self.statics = statics
+        self.tainted: Set[str] = set()
+        self.locals: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    # -- taint seeding ----------------------------------------------------
+
+    def _params(self, func: ast.AST) -> List[str]:
+        a = func.args
+        names = [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def run(self) -> List[Finding]:
+        for p in self._params(self.func):
+            self.locals.add(p)
+            if p not in self.statics and p not in ("self", "cls"):
+                self.tainted.add(p)
+        body = (
+            self.func.body
+            if isinstance(self.func.body, list)
+            else [ast.Expr(self.func.body)]  # lambda
+        )
+        for _ in range(3):  # fixpoint-ish: late defs feeding earlier loops
+            before = set(self.tainted)
+            for stmt in body:
+                self._propagate(stmt)
+            if self.tainted == before:
+                break
+        for stmt in body:
+            self._detect(stmt)
+        return self.findings
+
+    # -- expression taint -------------------------------------------------
+
+    def taints(self, e: ast.AST) -> bool:
+        if e is None or isinstance(e, (ast.Constant, ast.Lambda)):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return False
+            return self.taints(e.value)
+        if isinstance(e, ast.Call):
+            root = _attr_root(e.func)
+            if root and self.info.module_kind(root) == "jax":
+                return True  # jnp./jax./lax. calls produce tracers in jit
+            if (
+                isinstance(e.func, ast.Name)
+                and e.func.id in EXEMPT_CALLS | COERCIONS
+            ):
+                return False  # host scalars (the coercion itself is JX001)
+            return any(self.taints(a) for a in e.args) or any(
+                self.taints(k.value) for k in e.keywords
+            ) or self.taints(e.func)
+        if isinstance(e, ast.Subscript):
+            return self.taints(e.value) or self.taints(e.slice)
+        if isinstance(e, (ast.BinOp,)):
+            return self.taints(e.left) or self.taints(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.taints(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.taints(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return self.taints(e.left) or any(self.taints(c) for c in e.comparators)
+        if isinstance(e, ast.IfExp):
+            return self.taints(e.body) or self.taints(e.orelse)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.taints(el) for el in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(self.taints(v) for v in e.values if v is not None)
+        if isinstance(e, ast.Starred):
+            return self.taints(e.value)
+        if isinstance(e, (ast.GeneratorExp, ast.ListComp, ast.SetComp, ast.DictComp)):
+            return any(self.taints(g.iter) for g in e.generators)
+        if isinstance(e, ast.Slice):
+            return any(
+                self.taints(x) for x in (e.lower, e.upper, e.step) if x is not None
+            )
+        return False
+
+    def branch_taint(self, e: ast.AST) -> bool:
+        """Taint of a CONDITION, after the host-safe exemptions."""
+        if isinstance(e, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in e.ops
+        ):
+            return False
+        if (
+            isinstance(e, ast.Call)
+            and isinstance(e.func, ast.Name)
+            and e.func.id in EXEMPT_CALLS
+        ):
+            return False
+        if isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.Not):
+            return self.branch_taint(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.branch_taint(v) for v in e.values)
+        return self.taints(e)
+
+    # -- statement-level propagation --------------------------------------
+
+    def _assign_target(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            self.locals.add(target.id)
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign_target(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, tainted)
+        # Subscript/Attribute stores don't rebind a name: skip
+
+    def _seed_nested(self, fn: ast.AST) -> None:
+        """Taint a nested def's params from its visible call sites; a
+        helper never called in view defaults to all-tainted."""
+        params = self._params(fn)
+        pos = [x.arg for x in fn.args.posonlyargs + fn.args.args]
+        calls = [
+            c
+            for c in ast.walk(self.func)
+            if isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Name)
+            and isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and c.func.id == fn.name
+        ]
+        if not calls:
+            for p in params:
+                self.locals.add(p)
+                self.tainted.add(p)
+            return
+        taint_by_name: Dict[str, bool] = {p: False for p in params}
+        for c in calls:
+            for i, a in enumerate(c.args):
+                if i < len(pos):
+                    taint_by_name[pos[i]] = taint_by_name[pos[i]] or self.taints(a)
+            for kw in c.keywords:
+                if kw.arg in taint_by_name:
+                    taint_by_name[kw.arg] = taint_by_name[kw.arg] or self.taints(
+                        kw.value
+                    )
+        for p, t in taint_by_name.items():
+            self.locals.add(p)
+            if t:
+                self.tainted.add(p)
+
+    def _propagate(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            t = self.taints(stmt.value)
+            for target in stmt.targets:
+                self._assign_target(target, t)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign_target(stmt.target, self.taints(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                self.locals.add(stmt.target.id)
+                if self.taints(stmt.value) or stmt.target.id in self.tainted:
+                    self.tainted.add(stmt.target.id)
+        elif isinstance(stmt, ast.For):
+            self._assign_target(stmt.target, self.taints(stmt.iter))
+            for s in stmt.body + stmt.orelse:
+                self._propagate(s)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            for s in stmt.body + stmt.orelse:
+                self._propagate(s)
+        elif isinstance(stmt, ast.With):
+            for s in stmt.body:
+                self._propagate(s)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self._propagate(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._propagate(s)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.locals.add(stmt.name)
+            self._seed_nested(stmt)
+            for s in stmt.body:
+                self._propagate(s)
+
+    # -- detection --------------------------------------------------------
+
+    def _add(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(
+            Finding(self.path, node.lineno, node.col_offset, code, message)
+        )
+
+    def _detect(self, stmt: ast.AST) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, (ast.If, ast.While)):
+                if self.branch_taint(node.test):
+                    self._add(
+                        node,
+                        "JX002",
+                        "Python branch on a traced value inside a "
+                        "jit-traced function (use jnp.where / lax.cond)",
+                    )
+            elif isinstance(node, ast.IfExp):
+                if self.branch_taint(node.test):
+                    self._add(
+                        node,
+                        "JX002",
+                        "ternary on a traced value inside a jit-traced "
+                        "function (use jnp.where)",
+                    )
+            elif isinstance(node, ast.Assert):
+                if self.branch_taint(node.test):
+                    self._add(
+                        node,
+                        "JX002",
+                        "assert on a traced value inside a jit-traced "
+                        "function (use checkify or a host-side check)",
+                    )
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if (
+                    node.id in self.info.array_globals
+                    and node.id not in self.locals
+                ):
+                    self._add(
+                        node,
+                        "JX004",
+                        f"jit-traced function closes over module-level "
+                        f"array '{node.id}' (baked in as a constant; "
+                        f"pass it as an argument)",
+                    )
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            self._check_defaults(stmt)
+
+    def _check_call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in SYNC_METHODS
+            and self.taints(f.value)
+        ):
+            self._add(
+                node,
+                "JX001",
+                f".{f.attr}() on a traced value forces a device->host "
+                f"sync inside a jit-traced function",
+            )
+            return
+        if (
+            isinstance(f, ast.Name)
+            and f.id in COERCIONS
+            and node.args
+            and self.taints(node.args[0])
+        ):
+            self._add(
+                node,
+                "JX001",
+                f"{f.id}() coercion of a traced value forces a "
+                f"device->host sync inside a jit-traced function",
+            )
+            return
+        root = _attr_root(f)
+        if root and self.info.module_kind(root) == "numpy":
+            if any(self.taints(a) for a in node.args) or any(
+                self.taints(k.value) for k in node.keywords
+            ):
+                self._add(
+                    node,
+                    "JX001",
+                    "numpy call on a traced value inside a jit-traced "
+                    "function (np.* concretizes: device->host sync; "
+                    "use jnp)",
+                )
+
+    def _check_defaults(self, fn: ast.AST) -> None:
+        a = fn.args
+        for default in list(a.defaults) + [d for d in a.kw_defaults if d]:
+            bad = isinstance(default, MUTABLE_DEFAULTS) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("dict", "list", "set")
+            )
+            if bad:
+                self._add(
+                    default,
+                    "JX003",
+                    "mutable default argument on a jit-traced function "
+                    "(unstable cache key: every call risks a retrace)",
+                )
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, 0, "JX000", f"syntax error: {e.msg}")]
+    info = ModuleInfo(tree)
+    findings: List[Finding] = []
+    for func, statics in collect_jit_functions(info, tree):
+        # JX003 applies to the jit function's own signature even before
+        # the taint pass
+        checker = TaintChecker(info, path, func, statics)
+        checker._check_defaults(func)
+        findings.extend(checker.run())
+    lines = source.splitlines()
+    out = []
+    seen = set()
+    for f in findings:
+        key = (f.path, f.line, f.col, f.code)
+        if key in seen:
+            continue
+        seen.add(key)
+        line_src = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        m = _IGNORE_RE.search(line_src)
+        if m:
+            codes = m.group(1)
+            if codes is None or f.code in {c.strip() for c in codes.split(",")}:
+                continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col))
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["cyclonus_tpu/engine"],
+        help="files/directories to lint (default: cyclonus_tpu/engine)",
+    )
+    args = ap.parse_args(argv)
+    findings: List[Finding] = []
+    files = iter_py_files(args.paths)
+    for path in files:
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f.render())
+    print(
+        f"jaxlint: {len(findings)} finding(s) in {len(files)} file(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,10 @@ def run_bench(out_path: str, bound_s: float = None) -> dict:
         }
     except subprocess.TimeoutExpired:
         result = {"error": f"bench exceeded the {bound_s:g}s subprocess bound"}
+    except json.JSONDecodeError as e:
+        # a killed/crashed bench can leave a TRUNCATED final JSON line on
+        # stdout; that's an error result, not a watchdog-loop killer
+        result = {"error": f"bench stdout ended in unparseable JSON: {e}"}
     result["bench_rc"] = rc
     result["at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
