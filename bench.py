@@ -657,6 +657,7 @@ def _bench(done):
     n_samples = int(os.environ.get("BENCH_SAMPLE", "25"))
     rng = random.Random(20260729)
 
+    from cyclonus_tpu import telemetry
     from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
     from cyclonus_tpu.matcher import build_network_policies
 
@@ -717,17 +718,17 @@ def _bench(done):
                 cases, block=block, backend=counts_backend
             )
 
-        from cyclonus_tpu.utils import tracing
-
         _enter_phase("warmup")
-        tracing.reset()
+        telemetry.reset()
         t0 = time.time()
         counts = run_tiled()
         t_warm = time.time() - t0
         # what warmup is made of: single-buffer transfer vs trace+compile
-        # +first-execution (the engine.dispatch phase)
+        # +first-execution (the engine.dispatch phase) — from the
+        # telemetry span registry (the old ad-hoc phase dict, upgraded)
         warm_phases = {
-            k: round(v["total_s"], 3) for k, v in tracing.stats().items()
+            k: round(v["total_s"], 3)
+            for k, v in telemetry.SPANS.stats().items()
         }
         _enter_phase("eval")
         times = []
@@ -946,6 +947,11 @@ def _bench(done):
                         # (BENCH_MESH=0 to skip): shard shapes + counts
                         # pinned; flat wall-clock = conserved work
                         "mesh_scaling": mesh_detail,
+                        # full telemetry snapshot (metrics incl. cache
+                        # hit/miss + HBM watermarks, span aggregates,
+                        # flight-recorder window) so tunnel_wait round
+                        # files carry the engine's internal state
+                        "telemetry": telemetry.snapshot(),
                     },
                 }
             )
@@ -1001,6 +1007,7 @@ def _bench(done):
                     "eval_s": round(t_eval, 4),
                     "allow_rate": round(allow_rate, 4),
                     "parity_spot_checks": n_samples,
+                    "telemetry": telemetry.snapshot(),
                 },
             }
         )

@@ -119,6 +119,14 @@ def setup_generate(sub) -> None:
         action="store_true",
         help="print per-phase wall-clock timers at the end of the run",
     )
+    cmd.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve Prometheus /metrics (+ /telemetry.json) on "
+        "127.0.0.1:PORT for the run (0 = ephemeral port)",
+    )
     cmd.set_defaults(func=run_generate)
 
 
@@ -129,6 +137,9 @@ def run_generate(args) -> int:
     if args.resume and not args.journal:
         # validate before any cluster resources get created
         raise SystemExit("--resume requires --journal")
+    from .probe_cmd import _start_metrics
+
+    _start_metrics(args)
     namespaces = args.server_namespace or ["x", "y", "z"]
     pods = args.server_pod or ["a", "b", "c"]
     ports = args.server_port or [80, 81]

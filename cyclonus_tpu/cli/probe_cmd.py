@@ -19,6 +19,17 @@ from ..probe.resources import Resources
 from ..probe.runner import DEFAULT_ENGINE, ENGINE_CHOICES
 
 
+def _start_metrics(args) -> None:
+    """Shared --metrics-port hookup for probe/generate: a daemon
+    http.server thread serving the process-global telemetry registry."""
+    if getattr(args, "metrics_port", None) is None:
+        return
+    from ..telemetry.server import start_metrics_server
+
+    srv = start_metrics_server(args.metrics_port)
+    print(f"telemetry: metrics on {srv.url}/metrics")
+
+
 def setup_probe(sub) -> None:
     cmd = sub.add_parser("probe", help="run a connectivity probe against a cluster")
     cmd.add_argument("--mock", action="store_true", help="use an in-memory mock cluster")
@@ -95,10 +106,19 @@ def setup_probe(sub) -> None:
         action="store_true",
         help="ignore loopback cells in correctness verification",
     )
+    cmd.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve Prometheus /metrics (+ /telemetry.json) on "
+        "127.0.0.1:PORT for the run (0 = ephemeral port)",
+    )
     cmd.set_defaults(func=run_probe)
 
 
 def run_probe(args) -> int:
+    _start_metrics(args)
     namespaces = args.server_namespace or ["x", "y", "z"]
     pods = args.server_pod or ["a", "b", "c"]
     ports = args.server_port or [80, 81]

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ipaddress
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -17,6 +18,7 @@ import numpy as np
 
 from ..kube.ipaddr import is_ip_address_match_for_ip_block
 from ..matcher.core import Policy
+from ..telemetry import instruments as ti
 from ..utils.tracing import phase
 from .encoding import PEER_IP, PolicyEncoding, _DirectionEncoding, encode_policy
 
@@ -749,15 +751,16 @@ class TpuPolicyEngine:
             n = self.encoding.cluster.n_pods
             empty = np.zeros((0, n, n), dtype=bool)
             return GridVerdict(self.pod_keys, [], empty, empty.copy(), empty.copy())
-        tensors = self._tensors_with_cases(cases, device=True)
-        # dispatch-only timing: jit calls return once enqueued (async);
-        # device execution time lands in grid.fetch / allow_stats
-        with phase("engine.dispatch"):
-            out = evaluate_grid_kernel(tensors)
+        n = self.encoding.cluster.n_pods
+        with ti.eval_flight("grid", n, len(cases), dispatch_only=True):
+            tensors = self._tensors_with_cases(cases, device=True)
+            # dispatch-only timing: jit calls return once enqueued (async);
+            # device execution time lands in grid.fetch / allow_stats
+            with phase("engine.dispatch"):
+                out = evaluate_grid_kernel(tensors)
         # kernel emits [q, ...] layout directly: one device execution
         # total.  Bucketing pads the pod axis; the lazy device slice
         # strips the pad rows so GridVerdict stays exactly n x n.
-        n = self.encoding.cluster.n_pods
         return GridVerdict(
             self.pod_keys,
             list(cases),
@@ -914,6 +917,10 @@ class TpuPolicyEngine:
         itemsize = 2 if _resolve_operand_dtype(None) == "bf16" else 1
         bytes_per_case = n_tiles * slab_w_aug() * n_b * itemsize
         budget = int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
+        # watermark gauges: planned slab HBM (q=2 budget point) vs the
+        # budget — set before the gate so a rejected plan is visible too
+        ti.SLAB_HBM_BYTES.set(2 * bytes_per_case)
+        ti.SLAB_HBM_BUDGET_BYTES.set(budget)
         if 2 * bytes_per_case > budget:
             return None
         self._slab_bytes_per_case = bytes_per_case
@@ -1090,6 +1097,7 @@ class TpuPolicyEngine:
                 "candidate_error": None if status == "timeout" else repr(value),
                 "orphan_overlap_dispatches": 0,
             }
+            ti.AUTOTUNE_OUTCOMES.inc(outcome=status)
             if status == "timeout":
                 # the abandoned daemon thread may still hold one in-flight
                 # compile+execution; gate the NEXT dispatch on it so a
@@ -1116,6 +1124,9 @@ class TpuPolicyEngine:
             "default_s": round(t_default, 4),
             "slab_s": round(t_slab, 4),
         }
+        ti.AUTOTUNE_OUTCOMES.inc(
+            outcome="slab" if self._slab_choice else "default"
+        )
         logging.getLogger(__name__).info(
             "slab autotune: default %.4fs, slab %.4fs -> %s",
             t_default,
@@ -1211,8 +1222,20 @@ class TpuPolicyEngine:
                 ops, interpret=interpret
             )
         )
+        ti.ENGINE_PROGRAMS_BUILT.inc()
 
     def _counts_pallas_packed(self, cases: Sequence[PortCase], n: int) -> Dict[str, int]:
+        """Telemetry shell around the pallas counts path: one flight-
+        recorder entry + latency/throughput instruments per evaluation
+        (host-side only — the timed body below never syncs for it)."""
+        with ti.eval_flight("counts.pallas", n, len(cases)) as fl:
+            counts = self._counts_pallas_dispatch(cases, n, fl)
+            fl.set(cells=counts["cells"])
+            return counts
+
+    def _counts_pallas_dispatch(
+        self, cases: Sequence[PortCase], n: int, fl
+    ) -> Dict[str, int]:
         """The fused pallas counts path over the SINGLE-BUFFER tensor
         transfer: unpack + pod-axis ns-sort + precompute + pallas counts
         all trace into one jit, so a cold process pays one host->device
@@ -1255,10 +1278,15 @@ class TpuPolicyEngine:
         key, slab_ok, slab_args, (q_port, q_name, q_proto) = (
             self._steady_state_args(cases)
         )
+        t_dispatch = time.perf_counter()
+        autotuned = False
         if self._pre_cache is not None and self._pre_cache[0] == key:
             # steady state: only the pallas counts kernel runs
             self._pre_cache_misses = 0
+            ti.PRE_CACHE_HITS.inc()
+            fl.set(mode="steady", slab=slab_args[0] is not None)
             if slab_ok and self._slab_choice is None:
+                autotuned = True
                 # autotune at the first steady-state call: both programs
                 # run from the SAME pinned precompute, so this times
                 # exactly what every later call will execute
@@ -1277,6 +1305,9 @@ class TpuPolicyEngine:
             # to the split path and keep the precompute device-resident.
             # The split programs compile once (persistently cached); the
             # cold first call keeps the single fused compile.
+            ti.PRE_CACHE_MISSES.inc()
+            ti.PRE_CACHE_BUDGET_BYTES.set(_PRE_CACHE_MAX_BYTES)
+            fl.set(mode="split")
             with phase("engine.dispatch"):
                 pre = self._pre_jit(
                     buf, self._pod_perm_dev, q_port, q_name, q_proto
@@ -1288,6 +1319,7 @@ class TpuPolicyEngine:
                     self._pre_cache = (key, pre)  # evicts any other set
                     self._slab_ops_cache = None  # stale for the new set
                     self._pre_cache_misses = 0
+                    ti.PRE_CACHE_BYTES.set(nbytes)
                 else:
                     # too big to pin: remember, so repeats go back to the
                     # single fused dispatch instead of this split path
@@ -1301,6 +1333,8 @@ class TpuPolicyEngine:
                 )
         else:
             self._last_counts_key = key
+            ti.PRE_CACHE_MISSES.inc()
+            fl.set(mode="fused")
             if self._pre_cache is not None:
                 # release the cached set's HBM only after two consecutive
                 # other-set evaluations: a single interleaved call (the
@@ -1309,16 +1343,24 @@ class TpuPolicyEngine:
                 if self._pre_cache_misses >= 2:
                     self._pre_cache = None
                     self._slab_ops_cache = None  # its HBM goes with the pre
+                    ti.PRE_CACHE_BYTES.set(0)
             with phase("engine.dispatch"):
                 partials = self._counts_packed_jit(
                     buf, self._pod_perm_dev, q_port, q_name, q_proto,
                     np.int32(n), *slab_args,
                 )
+        if not autotuned:
+            # the autotune branch runs synchronous timed executions of
+            # both candidate programs — recording that window as "async
+            # dispatch" would poison the dispatch-vs-device split
+            ti.EVAL_DISPATCH_SECONDS.set(time.perf_counter() - t_dispatch)
         # the [Q, n_tiles, 3] readback is the execution barrier: device
         # run time (and, on a remote-attached chip, any service-side
         # stall) lands here, not in the async dispatch above
+        t_execute = time.perf_counter()
         with phase("engine.execute"):
             partials = np.asarray(partials)
+        ti.EVAL_EXECUTE_SECONDS.set(time.perf_counter() - t_execute)
         return sum_partials(partials, len(cases), n)
 
     def _steady_state_args(self, cases: Sequence[PortCase]):
@@ -1358,12 +1400,21 @@ class TpuPolicyEngine:
             self._slab_ops_cache is not None
             and self._slab_ops_cache[0] == key
         ):
+            ti.SLAB_OPS_CACHE_HITS.inc()
             return self._slab_ops_cache[1]
+        ti.SLAB_OPS_CACHE_MISSES.inc()
         slab = self._slab_plan_state
         n32 = np.int32(self.encoding.cluster.n_pods)
         ops = self._slab_ops_jit(
             self._pre_cache[1], n32, slab["egress"], slab["ingress"],
             w=slab.get("w"),
+        )
+        # the ACTUAL pinned bytes supersede the plan-time q=2 estimate
+        # (.nbytes is a host-side attribute: no device sync)
+        import jax as _jax
+
+        ti.SLAB_HBM_BYTES.set(
+            sum(x.nbytes for x in _jax.tree_util.tree_leaves(ops))
         )
         # check-and-fill under the SAME lock as the autotune's rejection
         # writes: without it an abandoned candidate thread can pass the
@@ -1416,7 +1467,14 @@ class TpuPolicyEngine:
         dt = (_time.perf_counter() - t0) / reps
         from .pallas_kernel import sum_partials
 
-        return dt, sum_partials(partials, len(cases), n)
+        counts = sum_partials(partials, len(cases), n)
+        # the pipelined rate as a REAL gauge: what a co-located or
+        # batched caller sustains, vs the sync eval's dispatch-RTT-bound
+        # number (the r5 gap this telemetry layer exists to expose)
+        if dt > 0:
+            ti.EVAL_DEVICE_SECONDS.set(dt)
+            ti.EVAL_PIPELINED_CELLS_PER_SEC.set(counts["cells"] / dt)
+        return dt, counts
 
     def evaluate_grid_counts_sharded(
         self,
@@ -1501,9 +1559,12 @@ class TpuPolicyEngine:
         if not cases or len(pairs) == 0:
             return np.zeros((len(pairs), len(cases), 3), dtype=bool)
         idx = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
-        out = evaluate_pairs_kernel(
-            self._tensors_with_cases(cases, device=True), idx[:, 0], idx[:, 1]
-        )
+        with ti.eval_flight(
+            "pairs", self.encoding.cluster.n_pods, len(cases), k=len(pairs)
+        ):
+            out = evaluate_pairs_kernel(
+                self._tensors_with_cases(cases, device=True), idx[:, 0], idx[:, 1]
+            )
         return np.stack(
             [
                 np.asarray(out["ingress"]),

@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..telemetry import instruments as ti
+
 # base tile sizes: BS/BD are the src/dst tile heights (MXU-aligned), KT
 # the MAX target-axis chunk.  The actual per-call sizes come from
 # _kt_for (shrinks KT to the live target count) and _tiles_for (doubles
@@ -133,6 +135,7 @@ def _make_verdict_counts_kernel(n_k_e: int, n_k_i: int):
     near block diagonal, so most blocks are empty and their matmuls are
     skipped entirely — this is where the 10k-policy regime's T-axis
     flops go."""
+    ti.KERNEL_TRACES.inc(kernel="counts_chunked")
 
     def _verdict_counts_kernel(
         nz_e_ref,  # [n_i * n_k_e] int32 scalar-prefetch: tmatch_e block nonzero
@@ -244,6 +247,7 @@ def _make_verdict_counts_kernel_1chunk():
     epilogue.  The nz/redir skip machinery is also dropped: the
     pseudo-target row lives in the (only) chunk, so no block is ever
     all-zero."""
+    ti.KERNEL_TRACES.inc(kernel="counts_1chunk")
 
     def _verdict_counts_kernel_1chunk(
         a_e_ref,  # [BS, KT] bf16   tmatch_e^T src block
@@ -427,6 +431,10 @@ def _verdict_counts_pallas_rect(
     bit-identical vs bf16 and numpy).  CYCLONUS_PALLAS_DTYPE=bf16
     (resolved by the public wrappers, static here) restores the float
     path."""
+    # trace-time side effect on purpose: each increment is one program
+    # trace = one compile-cache miss at the jit level (the persistent
+    # XLA cache may still serve the binary); dispatches - traces = hits
+    ti.KERNEL_TRACES.inc(kernel="counts_rect")
     od = jnp.bfloat16 if operand_dtype == "bf16" else jnp.int8
     ns = tmatch_e.shape[1]
     nd = tmatch_i.shape[1]
@@ -688,6 +696,7 @@ def _make_verdict_counts_kernel_slab():
     (`pe[:, None]`) are unsupported, 1-D int32 relayouts crash layout
     inference, and rank-1 dot_general OR-terms blow the 16 MB scoped
     VMEM stack at the (2048, 1024) tile."""
+    ti.KERNEL_TRACES.inc(kernel="counts_slab")
 
     def _kernel(
         a_e_ref,  # [1, Wa, BS] od — tmatch_e window+pseudo row, src tile i

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..telemetry import instruments as ti
+
 try:  # JAX >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
@@ -269,12 +271,16 @@ def evaluate_grid_sharded(
             _sharded_eval, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
         )
     )
-    with mesh_device_context(mesh):
-        ingress_rows, egress, combined = fn(tensors)
-        # stay on device: strip pad rows and fix the ingress layout
-        # ([src, dst, q] -> [dst, src, q]) with lazy jnp ops
-        ingress_rows = ingress_rows[:n_pods, :n_pods]
-        egress = egress[:n_pods, :n_pods]
-        combined = combined[:n_pods, :n_pods]
-        ingress = jnp.swapaxes(ingress_rows, 0, 1)
+    with ti.eval_flight(
+        "grid.sharded", n_pods, int(tensors["q_port"].shape[0]),
+        devices=int(n_dev), dispatch_only=True,
+    ):
+        with mesh_device_context(mesh):
+            ingress_rows, egress, combined = fn(tensors)
+            # stay on device: strip pad rows and fix the ingress layout
+            # ([src, dst, q] -> [dst, src, q]) with lazy jnp ops
+            ingress_rows = ingress_rows[:n_pods, :n_pods]
+            egress = egress[:n_pods, :n_pods]
+            combined = combined[:n_pods, :n_pods]
+            ingress = jnp.swapaxes(ingress_rows, 0, 1)
     return ingress, egress, combined

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import instruments as ti
+from ..utils.tracing import phase
 from .kernel import (
     direction_precompute,
     m_tp_onehot,
@@ -267,11 +269,15 @@ def evaluate_grid_counts(
     # equivalent global-accumulator overflow bit the pallas backend at
     # 100k pods before partials were introduced)
     block = _int32_safe_block(min(block, max(n_pods, 1)), n_pods, q)
-    tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
-    counts = np.asarray(
-        _counts_kernel(tensors, block, n_tiles, n_pods), dtype=np.int64
-    ).sum(axis=0)
-    total = q * n_pods * n_pods
+    with ti.eval_flight("counts.xla", n_pods, q, block=block) as fl:
+        tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
+        with phase("engine.dispatch"):
+            out = _counts_kernel(tensors, block, n_tiles, n_pods)
+        # the readback is the execution barrier (dispatch is async)
+        with phase("engine.execute"):
+            counts = np.asarray(out, dtype=np.int64).sum(axis=0)
+        total = q * n_pods * n_pods
+        fl.set(cells=total)
     return {
         "ingress": int(counts[0]),
         "egress": int(counts[1]),
@@ -332,11 +338,13 @@ def _mesh_counts_setup(tensors: Dict, n_pods: int, block: int, mesh):
 
 
 def _run_mesh_counts(
-    per_device, mesh, in_specs, tensors: Dict, q: int, n_pods: int
+    per_device, mesh, in_specs, tensors: Dict, q: int, n_pods: int,
+    path: str = "counts.mesh",
 ) -> Dict[str, int]:
     """Shared tail of every mesh count path: one shard_map execution,
     then the int64 host sum of the [*, 3] int32 partials (device-side
-    int64 silently truncates without jax_enable_x64)."""
+    int64 silently truncates without jax_enable_x64).  `path` labels the
+    telemetry flight entry with the calling mesh strategy."""
     from jax.sharding import PartitionSpec as P
 
     from .sharded import mesh_device_context, shard_map_no_check
@@ -346,8 +354,12 @@ def _run_mesh_counts(
             per_device, mesh=mesh, in_specs=(in_specs,), out_specs=P()
         )
     )
-    with mesh_device_context(mesh):
-        counts = np.asarray(fn(tensors), dtype=np.int64).sum(axis=0)
+    with ti.eval_flight(
+        path, n_pods, q, devices=int(mesh.devices.size)
+    ) as fl:
+        with mesh_device_context(mesh):
+            counts = np.asarray(fn(tensors), dtype=np.int64).sum(axis=0)
+        fl.set(cells=q * n_pods * n_pods)
     return {
         "ingress": int(counts[0]),
         "egress": int(counts[1]),
@@ -424,7 +436,8 @@ def evaluate_grid_counts_ring(
         return jax.lax.all_gather(counts, "x", axis=0, tiled=True)
 
     return _run_mesh_counts(
-        per_device, mesh, pod_sharded_in_specs(tensors), tensors, q, n_pods
+        per_device, mesh, pod_sharded_in_specs(tensors), tensors, q, n_pods,
+        path="counts.ring",
     )
 
 
@@ -540,7 +553,9 @@ def evaluate_grid_counts_ring2d(
     in_specs = jax.tree_util.tree_map(
         _flatten_spec, in_specs, is_leaf=lambda x: isinstance(x, P)
     )
-    return _run_mesh_counts(per_device, mesh, in_specs, tensors, q, n_pods)
+    return _run_mesh_counts(
+        per_device, mesh, in_specs, tensors, q, n_pods, path="counts.ring2d"
+    )
 
 
 def evaluate_grid_counts_sharded(
@@ -625,7 +640,10 @@ def evaluate_grid_counts_sharded(
     from jax.sharding import PartitionSpec as P
 
     in_specs = jax.tree_util.tree_map(lambda _: P(), tensors)
-    return _run_mesh_counts(per_device, mesh, in_specs, tensors, q, n_pods)
+    return _run_mesh_counts(
+        per_device, mesh, in_specs, tensors, q, n_pods,
+        path="counts.sharded",
+    )
 
 
 @jax.jit

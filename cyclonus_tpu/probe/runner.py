@@ -19,6 +19,8 @@ from typing import Dict, List, Optional
 
 from ..kube.ikubernetes import IKubernetes, KubeError
 from ..matcher.core import Policy
+from ..telemetry import instruments as ti
+from ..telemetry.spans import span
 from .connectivity import (
     CONNECTIVITY_ALLOWED,
     CONNECTIVITY_BLOCKED,
@@ -35,6 +37,38 @@ from .table import Table
 DEFAULT_ENGINE = "tpu"
 # the CLI --engine vocabulary (tpu-sharded = tpu over the device mesh)
 ENGINE_CHOICES = ["oracle", "tpu", "tpu-sharded", "native"]
+
+# parity with the reference's logrus trace level (jobrunner.go:80 logs
+# every simulated verdict): CYCLONUS_TRACE_VERDICTS=1 logs each verdict
+# as it is scattered out of the grid.  Checked per probe (not cached) so
+# tests can flip it; the per-verdict work is guarded so the off path
+# costs one boolean.
+_verdict_logger = logging.getLogger("cyclonus.trace.verdicts")
+
+
+def _trace_verdicts() -> bool:
+    on = os.environ.get("CYCLONUS_TRACE_VERDICTS", "") == "1"
+    if on and _verdict_logger.level == logging.NOTSET:
+        # the flag is an explicit opt-in: without this, the logger would
+        # inherit the CLI's default INFO root level and the DEBUG-level
+        # verdict lines would silently vanish (the root handler's own
+        # level is NOTSET, so lowering just this logger is enough)
+        _verdict_logger.setLevel(logging.DEBUG)
+    return on
+
+
+def _log_verdict(engine: str, job, ingress: str, egress: str, combined: str) -> None:
+    _verdict_logger.debug(
+        "verdict [%s] %s -> %s %s/%s: ingress=%s egress=%s combined=%s",
+        engine,
+        job.from_key,
+        job.to_key,
+        job.resolved_port,
+        job.protocol,
+        ingress,
+        egress,
+        combined,
+    )
 
 _BACKEND_STATE = {"checked": False, "available": False}
 
@@ -153,7 +187,7 @@ class SimulatedJobRunner(JobRunner):
 
     def run_job(self, job: Job) -> JobResult:
         allowed = self.policies.is_traffic_allowed(job.traffic())
-        return JobResult(
+        result = JobResult(
             job=job,
             ingress=CONNECTIVITY_ALLOWED
             if allowed.ingress.is_allowed
@@ -165,6 +199,12 @@ class SimulatedJobRunner(JobRunner):
             if allowed.is_allowed
             else CONNECTIVITY_BLOCKED,
         )
+        ti.VERDICTS.inc(engine="oracle")
+        if _trace_verdicts():
+            _log_verdict(
+                "oracle", job, result.ingress, result.egress, result.combined
+            )
+        return result
 
     # --- TPU path ---
 
@@ -208,13 +248,24 @@ class SimulatedJobRunner(JobRunner):
                 return self.run_jobs_with_resources(jobs, resources)
             from ..engine import TpuPolicyEngine
 
-            engine = TpuPolicyEngine(self.policies, pods, resources.namespaces)
-            pod_index = engine.pod_index()
-            if self.sharded:
-                grid = engine.evaluate_grid_sharded(cases)
-            else:
-                grid = engine.evaluate_grid(cases)
+            with span(
+                "probe.simulated",
+                engine=self.engine,
+                sharded=self.sharded,
+                pods=len(pods),
+                cases=len(cases),
+                jobs=len(jobs),
+            ):
+                engine = TpuPolicyEngine(
+                    self.policies, pods, resources.namespaces
+                )
+                pod_index = engine.pod_index()
+                if self.sharded:
+                    grid = engine.evaluate_grid_sharded(cases)
+                else:
+                    grid = engine.evaluate_grid(cases)
 
+        trace = _trace_verdicts()
         results = []
         for job in jobs:
             qi = case_index[
@@ -231,6 +282,10 @@ class SimulatedJobRunner(JobRunner):
                     combined=CONNECTIVITY_ALLOWED if combined else CONNECTIVITY_BLOCKED,
                 )
             )
+            if trace:
+                r = results[-1]
+                _log_verdict(self.engine, job, r.ingress, r.egress, r.combined)
+        ti.VERDICTS.inc(len(jobs), engine=self.engine)
         return results
 
 
@@ -311,6 +366,19 @@ class KubeBatchJobRunner(JobRunner):
             results = self.client.batch(batch)
         except KubeError:
             return [(r.key, CONNECTIVITY_CHECK_FAILED) for r in batch.requests]
+        for r in results:
+            # workers report per-probe latency (worker/model.py
+            # latency_ms, optional for old workers): the driver-side
+            # histogram is the real-probe latency data source.  Blocked/
+            # failed probes carry retry+timeout time, so they land in a
+            # separate outcome series and never distort the ok-latency
+            # percentiles.
+            if r.latency_ms is not None:
+                ti.PROBE_LATENCY.observe(
+                    r.latency_ms / 1000.0,
+                    source="batch",
+                    outcome="ok" if r.is_success() else "error",
+                )
         return [
             (
                 r.request.key,

@@ -1,0 +1,192 @@
+"""The named instruments of the TPU verdict engine.
+
+One place declares every `cyclonus_tpu_*` metric (naming scheme:
+docs/DESIGN.md "Telemetry") so the exposition schema is stable and the
+engine call sites stay one-liners.  Unlabeled gauges/counters exist from
+import, so a scrape of a fresh process already shows the full schema.
+
+`eval_flight` is the per-evaluation wrapper the engine hot paths use: it
+times the evaluation, feeds the latency histogram / throughput gauges,
+and appends a flight-recorder entry (including on crash, with the
+exception as the outcome).  Cost per eval when enabled: two
+perf_counter reads, a handful of locked dict updates, one ring append —
+host-side only, never a device sync (pinned by the jaxlint test).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from . import recorder, state
+from .metrics import REGISTRY
+
+# --- evaluation throughput / latency ------------------------------------
+
+EVAL_CELLS_PER_SEC = REGISTRY.gauge(
+    "cyclonus_tpu_eval_cells_per_sec",
+    "Most recent synchronous evaluation rate (grid cells per second).",
+)
+EVAL_PIPELINED_CELLS_PER_SEC = REGISTRY.gauge(
+    "cyclonus_tpu_eval_pipelined_cells_per_sec",
+    "Device-side steady-state rate with dispatch RTT amortized over "
+    "in-flight evaluations (counts_pipelined_eval_s).",
+)
+EVAL_LATENCY = REGISTRY.histogram(
+    "cyclonus_tpu_eval_latency_seconds",
+    "Wall-clock per engine evaluation, by kernel path.",
+    labelnames=("path",),
+)
+EVAL_DISPATCHES = REGISTRY.counter(
+    "cyclonus_tpu_eval_dispatches_total",
+    "Engine evaluations dispatched, by kernel path.",
+    labelnames=("path",),
+)
+EVAL_DISPATCH_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_eval_dispatch_seconds",
+    "Host time of the most recent async dispatch (enqueue only; the "
+    "device may still be executing).",
+)
+EVAL_EXECUTE_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_eval_execute_seconds",
+    "Time of the most recent readback barrier (absorbs device execution "
+    "and, on a tunneled chip, the round trip).",
+)
+EVAL_DEVICE_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_eval_device_seconds",
+    "Steady-state device seconds per evaluation from the pipelined "
+    "timing loop (the dispatch-vs-device split's device half).",
+)
+
+# --- HBM watermarks ------------------------------------------------------
+
+SLAB_HBM_BYTES = REGISTRY.gauge(
+    "cyclonus_tpu_slab_hbm_bytes",
+    "Slab-kernel HBM bytes: planned at slab-plan time (q=2 budget "
+    "point), updated to the actual pinned operand bytes when cached.",
+)
+SLAB_HBM_BUDGET_BYTES = REGISTRY.gauge(
+    "cyclonus_tpu_slab_hbm_budget_bytes",
+    "CYCLONUS_SLAB_MAX_BYTES budget the slab plan is gated against.",
+)
+PRE_CACHE_BYTES = REGISTRY.gauge(
+    "cyclonus_tpu_pre_cache_bytes",
+    "Device-resident precompute bytes currently pinned (0 = no pin).",
+)
+PRE_CACHE_BUDGET_BYTES = REGISTRY.gauge(
+    "cyclonus_tpu_pre_cache_budget_bytes",
+    "Precompute pin ceiling (engine/api.py _PRE_CACHE_MAX_BYTES).",
+)
+
+# --- cache hit/miss counters --------------------------------------------
+
+PRE_CACHE_HITS = REGISTRY.counter(
+    "cyclonus_tpu_pre_cache_hits_total",
+    "Counts evaluations served from the pinned device-resident "
+    "precompute (steady state: only the counts kernel runs).",
+)
+PRE_CACHE_MISSES = REGISTRY.counter(
+    "cyclonus_tpu_pre_cache_misses_total",
+    "Counts evaluations that could not use a pinned precompute (cold "
+    "call, case-set change, or cache declined/evicted).",
+)
+SLAB_OPS_CACHE_HITS = REGISTRY.counter(
+    "cyclonus_tpu_slab_ops_cache_hits_total",
+    "Slab dispatches served from cached gathered operands "
+    "(engine/api.py _slab_ops_for).",
+)
+SLAB_OPS_CACHE_MISSES = REGISTRY.counter(
+    "cyclonus_tpu_slab_ops_cache_misses_total",
+    "Slab operand builds (cache cold or evicted with the precompute).",
+)
+KERNEL_TRACES = REGISTRY.counter(
+    "cyclonus_tpu_kernel_traces_total",
+    "jit traces of the verdict kernels, by kernel: each trace is a "
+    "compile-cache miss at the program level (dispatches - traces = "
+    "hits); the persistent XLA cache may still serve the binary.",
+    labelnames=("kernel",),
+)
+ENGINE_PROGRAMS_BUILT = REGISTRY.counter(
+    "cyclonus_tpu_engine_programs_built_total",
+    "Per-engine counts-program families built (api._build_counts_jits).",
+)
+
+# --- autotune ------------------------------------------------------------
+
+AUTOTUNE_OUTCOMES = REGISTRY.counter(
+    "cyclonus_tpu_autotune_outcomes_total",
+    "Slab-vs-default autotune outcomes: winner (slab/default) or "
+    "candidate containment (error/timeout).",
+    labelnames=("outcome",),
+)
+
+# --- real-probe latency --------------------------------------------------
+
+PROBE_LATENCY = REGISTRY.histogram(
+    "cyclonus_tpu_probe_latency_seconds",
+    "Per-probe real-connection latency (worker/model.py Result."
+    "latency_ms), observed in the worker and driver-side from batch "
+    "results.  outcome=error samples include retry+timeout time — keep "
+    "them out of connection-latency percentiles.",
+    labelnames=("source", "outcome"),
+)
+
+# --- verdict volume ------------------------------------------------------
+
+VERDICTS = REGISTRY.counter(
+    "cyclonus_tpu_verdicts_total",
+    "Simulated job verdicts scattered to callers, by engine.",
+    labelnames=("engine",),
+)
+
+
+class _NullFlight:
+    __slots__ = ()
+
+    def set(self, **kw: Any) -> "_NullFlight":
+        return self
+
+
+_NULL_FLIGHT = _NullFlight()
+
+
+class Flight:
+    """Mutable per-evaluation record; `set(cells=..., **attrs)` enriches
+    the flight entry (and, when cells is set, the throughput gauge)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Dict[str, Any]):
+        self.data = data
+
+    def set(self, **kw: Any) -> "Flight":
+        self.data.update(kw)
+        return self
+
+
+@contextlib.contextmanager
+def eval_flight(path: str, n_pods: int, q: int, **attrs: Any) -> Iterator[Flight]:
+    """Wrap one engine evaluation: histogram + dispatch counter + flight
+    record, outcome 'ok' or the exception repr."""
+    if not state.ENABLED:
+        yield _NULL_FLIGHT  # type: ignore[misc]
+        return
+    flight = Flight({"path": path, "n_pods": n_pods, "q": q, **attrs})
+    outcome = "ok"
+    t0 = time.perf_counter()
+    try:
+        yield flight
+    except BaseException as e:
+        outcome = f"{type(e).__name__}: {e}"[:300]
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        EVAL_LATENCY.observe(dt, path=path)
+        EVAL_DISPATCHES.inc(path=path)
+        cells = flight.data.get("cells")
+        if outcome == "ok" and cells and dt > 0:
+            EVAL_CELLS_PER_SEC.set(cells / dt)
+        flight.data["seconds"] = round(dt, 6)
+        flight.data["outcome"] = outcome
+        recorder.record(**flight.data)

@@ -1,0 +1,117 @@
+"""Flight recorder: a bounded ring of the last N engine evaluations.
+
+Each entry records what a post-mortem needs — shapes, kernel path, phase
+timings, outcome, wall-clock — and the ring (utils/bounded.py
+BoundedRing, CYCLONUS_FLIGHT_RECORDER_N entries, default 64) is dumped
+to JSON:
+
+  * automatically on an unhandled crash, via a chained `sys.excepthook`
+    installed lazily at the first recorded evaluation (so importing
+    telemetry never changes interpreter behavior);
+  * on demand via `dump()` / the `cyclonus-tpu telemetry` CLI mode.
+
+The dump path is CYCLONUS_FLIGHT_RECORDER_PATH, defaulting to
+`cyclonus-flight-recorder-<pid>.json` in the working directory.  The
+crash hook never masks the crash: any dump failure is swallowed and the
+previous excepthook always runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.bounded import BoundedRing
+from . import state
+
+
+def _default_capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("CYCLONUS_FLIGHT_RECORDER_N", "64")))
+    except ValueError:
+        return 64
+
+
+RING = BoundedRing(_default_capacity())
+
+_lock = threading.Lock()
+_seq = {"n": 0}
+_hook = {"installed": False, "previous": None}
+
+
+def record(**entry: Any) -> None:
+    """Append one evaluation record (timestamped + sequence-numbered)."""
+    if not state.ENABLED:
+        return
+    _install_crash_hook()
+    with _lock:
+        _seq["n"] += 1
+        entry["seq"] = _seq["n"]
+    entry["at"] = round(time.time(), 3)
+    RING.append(entry)
+
+
+def entries() -> List[Dict[str, Any]]:
+    return RING.snapshot()
+
+
+def reset() -> None:
+    RING.clear()
+    with _lock:
+        _seq["n"] = 0
+
+
+def dump_path() -> str:
+    return os.environ.get(
+        "CYCLONUS_FLIGHT_RECORDER_PATH",
+        f"cyclonus-flight-recorder-{os.getpid()}.json",
+    )
+
+
+def dump(path: Optional[str] = None, reason: str = "on-demand") -> str:
+    """Write the ring to JSON; returns the path written."""
+    path = path or dump_path()
+    payload = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "recorded_total": RING.appended,
+        "entries": entries(),
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+        f.write("\n")
+    return path
+
+
+# benign terminations that must not litter the cwd with dump files:
+# Ctrl-C, sys.exit, and a consumer closing our stdout (`... | head`)
+_NO_DUMP = (KeyboardInterrupt, SystemExit, BrokenPipeError)
+
+
+def _crash_hook(exc_type, exc, tb) -> None:
+    try:
+        if len(RING) and not issubclass(exc_type, _NO_DUMP):
+            dump(reason=f"crash: {exc_type.__name__}: {exc}")
+    except Exception:
+        pass  # the dump must never mask the crash itself
+    prev = _hook["previous"] or sys.__excepthook__
+    prev(exc_type, exc, tb)
+
+
+def _install_crash_hook() -> None:
+    if _hook["installed"]:
+        return
+    with _lock:
+        if _hook["installed"]:
+            return
+        _hook["previous"] = sys.excepthook
+        sys.excepthook = _crash_hook
+        _hook["installed"] = True

@@ -1,0 +1,108 @@
+"""Optional metrics endpoint: a stdlib http.server thread.
+
+`start_metrics_server(port)` binds 127.0.0.1:<port> (0 = ephemeral) and
+serves, on a daemon thread:
+
+    /metrics         Prometheus text exposition (curl-able scrape target)
+    /metrics.json    metrics snapshot as JSON
+    /telemetry.json  full snapshot: metrics + span tree + flight recorder
+    /healthz         200 ok
+
+Used by `probe`/`generate`/the worker via `--metrics-port`.  Stdlib-only
+by design (the container bakes no Prometheus client), and the thread is
+a daemon, so a finished CLI run never hangs on it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        from . import render_prometheus, snapshot
+
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._send(
+                render_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif path == "/metrics.json":
+            from .metrics import REGISTRY
+
+            self._send(
+                json.dumps(REGISTRY.snapshot(), default=str).encode(),
+                "application/json",
+            )
+        elif path == "/telemetry.json":
+            self._send(
+                json.dumps(snapshot(), default=str).encode(),
+                "application/json",
+            )
+        elif path == "/healthz":
+            self._send(b"ok\n", "text/plain")
+        else:
+            self._send(b"not found\n", "text/plain", 404)
+
+    def log_message(self, format: str, *args) -> None:
+        pass  # scrapes must not spam the CLI's stdout
+
+
+class MetricsServer:
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"cyclonus-metrics:{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_ACTIVE: dict = {"server": None}
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1") -> MetricsServer:
+    """Start (or return the already-running) metrics server.  One per
+    process: a second call with a different port replaces nothing — the
+    live server wins, matching the process-global registry it serves."""
+    srv = _ACTIVE["server"]
+    if srv is not None:
+        return srv
+    srv = MetricsServer(port, host)
+    _ACTIVE["server"] = srv
+    return srv
+
+
+def active_server() -> Optional[MetricsServer]:
+    return _ACTIVE["server"]
+
+
+def stop_metrics_server() -> None:
+    srv = _ACTIVE["server"]
+    if srv is not None:
+        _ACTIVE["server"] = None
+        srv.close()

@@ -12,7 +12,48 @@ JOIN it later, which a single bounded call cannot express.)
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+import threading
+from collections import deque
+from typing import Any, Callable, List, Tuple
+
+
+class BoundedRing:
+    """Thread-safe fixed-capacity append-only ring: the newest `maxlen`
+    items win.  The storage primitive of the telemetry flight recorder
+    (telemetry/recorder.py) — bounded by construction so a process that
+    evaluates forever holds a constant-size history."""
+
+    def __init__(self, maxlen: int):
+        if maxlen <= 0:
+            raise ValueError(f"BoundedRing maxlen must be positive, got {maxlen}")
+        self.maxlen = maxlen
+        self._lock = threading.Lock()
+        self._items: deque = deque(maxlen=maxlen)
+        self._appended = 0  # lifetime total, survives wrap-around
+
+    def append(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+            self._appended += 1
+
+    def snapshot(self) -> List[Any]:
+        """Oldest-to-newest copy of the current window."""
+        with self._lock:
+            return list(self._items)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._items.clear()
+            self._appended = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def appended(self) -> int:
+        with self._lock:
+            return self._appended
 
 
 def run_bounded(fn: Callable[[], Any], timeout_s: float) -> Tuple[str, Any]:
@@ -24,8 +65,6 @@ def run_bounded(fn: Callable[[], Any], timeout_s: float) -> Tuple[str, Any]:
     same blocking call on the main thread, which would just block on the
     same global init lock.
     """
-    import threading
-
     out: dict = {}
 
     def body():

@@ -1,13 +1,14 @@
-"""Phase timers + JAX profiler hooks.
+"""Phase timers + JAX profiler hooks — now a thin facade over
+`cyclonus_tpu.telemetry.spans`.
 
 The reference has no tracing/profiling at all (SURVEY.md section 5); its
 closest analog is logrus trace-level logging of each simulated verdict
-(jobrunner.go:80).  Here tracing is first-class: every engine evaluation
-records per-phase wall-clock (compile/encode/device_put/execute/fetch) in a
-process-local registry, and `jax_profile` wraps a block in a
-jax.profiler trace for TensorBoard/XProf.
+(jobrunner.go:80 — mirrored by CYCLONUS_TRACE_VERDICTS in
+probe/runner.py).  Tracing here is first-class: `phase` is a structured
+span (hierarchical, thread-safe, attribute-carrying), and this module
+keeps the historical flat API so existing consumers (bench.py, the
+generate --phase-stats flag, tests) are unchanged:
 
-Usage:
     with phase("encode"):
         ...
     stats()        -> {"encode": {"count": 3, "total_s": ..., "max_s": ...}}
@@ -15,53 +16,38 @@ Usage:
 
     with jax_profile("/tmp/trace"):   # no-op when dir is falsy
         engine.evaluate_grid(cases)
+
+For the hierarchical view, attributes, metrics, and the flight recorder,
+use `cyclonus_tpu.telemetry` directly.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
-import threading
-import time
 from typing import Dict, Iterator, Optional
+
+from ..telemetry.spans import REGISTRY, span as phase  # noqa: F401 (re-export)
 
 logger = logging.getLogger("cyclonus.trace")
 
-_lock = threading.Lock()
-_phases: Dict[str, Dict[str, float]] = {}
-
-
-@contextlib.contextmanager
-def phase(name: str) -> Iterator[None]:
-    """Accumulate wall-clock under `name`; nestable and thread-safe."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            rec = _phases.setdefault(
-                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
-            )
-            rec["count"] += 1
-            rec["total_s"] += dt
-            rec["max_s"] = max(rec["max_s"], dt)
-        logger.debug("phase %s: %.4fs", name, dt)
-
 
 def stats() -> Dict[str, Dict[str, float]]:
-    with _lock:
-        return {k: dict(v) for k, v in _phases.items()}
+    """Flat per-name aggregates (the pre-telemetry shape, preserved)."""
+    return REGISTRY.stats()
 
 
 def reset() -> None:
-    with _lock:
-        _phases.clear()
+    REGISTRY.reset()
 
 
 def render_stats() -> str:
     rows = sorted(stats().items())
     if not rows:
+        from ..telemetry import state
+
+        if not state.ENABLED:
+            return "(no phases recorded: telemetry disabled, CYCLONUS_TELEMETRY=0)"
         return "(no phases recorded)"
     out = [f"{'phase':<24}{'count':>8}{'total_s':>12}{'max_s':>10}"]
     for name, rec in rows:

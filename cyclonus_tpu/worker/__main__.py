@@ -18,7 +18,21 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--jobs", required=True, help="JSON-serialized worker Batch"
     )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve Prometheus /metrics on 127.0.0.1:PORT (0 = ephemeral) "
+        "for the batch's duration — per-probe latency histograms "
+        "(cyclonus_tpu_probe_latency_seconds) scrape here",
+    )
     args = parser.parse_args(argv)
+    if args.metrics_port is not None:
+        from ..telemetry.server import start_metrics_server
+
+        srv = start_metrics_server(args.metrics_port)
+        print(f"telemetry: metrics on {srv.url}/metrics", file=sys.stderr)
     print(run_worker(args.jobs))
     return 0
 

@@ -79,26 +79,38 @@ class Batch:
 
 @dataclass
 class Result:
-    """model.go:50-61."""
+    """model.go:50-61.
+
+    latency_ms is new vs the reference: per-probe wall-clock measured by
+    the worker (worker.py _issue_one), the data source for the driver's
+    real-probe latency histogram.  It is OPTIONAL on the wire in both
+    directions — old workers omit it, old drivers ignore the extra key —
+    so the JSON stays backward-compatible."""
 
     request: Request
     output: str = ""
     error: str = ""
+    latency_ms: Optional[float] = None
 
     def is_success(self) -> bool:
         return self.error == ""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "Request": self.request.to_dict(),
             "Output": self.output,
             "Error": self.error,
         }
+        if self.latency_ms is not None:
+            d["LatencyMs"] = self.latency_ms
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Result":
+        latency = d.get("LatencyMs")
         return Result(
             request=Request.from_dict(d["Request"]),
             output=d.get("Output", ""),
             error=d.get("Error", ""),
+            latency_ms=float(latency) if latency is not None else None,
         )

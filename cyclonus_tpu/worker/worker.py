@@ -6,9 +6,11 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List
 
+from ..telemetry import instruments as ti
 from .model import Batch, Request, Result
 
 DEFAULT_CONCURRENCY = 10
@@ -16,6 +18,22 @@ RETRIES = 1
 
 
 def _issue_one(request: Request) -> Result:
+    """Issue one probe (with retries), stamping per-probe wall-clock into
+    Result.latency_ms — the real-probe latency histogram's data source —
+    and the worker-side telemetry histogram."""
+    t0 = time.perf_counter()
+    result = _probe_with_retries(request)
+    dt = time.perf_counter() - t0
+    result.latency_ms = round(dt * 1000.0, 3)
+    ti.PROBE_LATENCY.observe(
+        dt,
+        source="worker",
+        outcome="ok" if result.is_success() else "error",
+    )
+    return result
+
+
+def _probe_with_retries(request: Request) -> Result:
     """worker.go:60-84 with one retry (worker.go:62-68).
 
     CYCLONUS_CONNECT_NATIVE=1 probes with python sockets instead of

@@ -142,3 +142,17 @@ class TestBenchGuards:
         assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
         # roofline only reports for the pallas backend
         assert detail["roofline"] is None
+        # the telemetry block rides every BENCH line (and thus every
+        # tunnel_wait round file): metrics incl. cache hit/miss counters
+        # + HBM watermarks, span aggregates, and the flight window
+        tel = detail["telemetry"]
+        assert "cyclonus_tpu_pre_cache_hits_total" in tel["metrics"]
+        assert "cyclonus_tpu_slab_hbm_bytes" in tel["metrics"]
+        assert "engine.dispatch" in tel["phases"]
+        assert any(
+            e["path"].startswith("counts.") for e in tel["flight_recorder"]
+        )
+        # warmup_phases now sources from the same span registry (encode
+        # happens before the warmup-start reset, so dispatch is the
+        # marker phase)
+        assert "engine.dispatch" in detail["warmup_phases"]
