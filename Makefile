@@ -5,15 +5,19 @@ test:
 	python -m pytest tests/ -q
 
 # static lint: ruff (when installed) + the JAX hot-path lint over the
-# engine and telemetry packages (tools/jaxlint.py — device-sync /
-# traced-branch / recompile-risk checks; see docs/DESIGN.md).
-# Telemetry is linted so instrumentation can never smuggle a device
-# sync into the hot path (tests/test_telemetry.py asserts the same).
+# engine, telemetry, and worker packages (tools/jaxlint.py —
+# device-sync / traced-branch / recompile-risk checks; see
+# docs/DESIGN.md).  Telemetry — including the trace-timeline modules
+# events.py/trace_export.py — and the worker (which now records trace
+# events on the probe path) are linted so instrumentation can never
+# smuggle a device sync into a hot path (tests/test_telemetry.py
+# asserts the same).
 lint:
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
-	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry
+	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
+	  cyclonus_tpu/worker
 
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, then run the suite on a

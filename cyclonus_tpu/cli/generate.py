@@ -111,8 +111,19 @@ def setup_generate(sub) -> None:
     )
     cmd.add_argument(
         "--jax-profile",
+        "--trace-dir",  # the flag pair probe/bench also spell
+        dest="jax_profile",
         default="",
+        metavar="DIR",
         help="write a jax profiler trace (TensorBoard/XProf) to this directory",
+    )
+    cmd.add_argument(
+        "--trace-out",
+        default="",
+        metavar="PATH",
+        help="record span enter/exit events and write the merged "
+        "driver+worker timeline as Chrome trace-event JSON to PATH at "
+        "exit (open in Perfetto / chrome://tracing)",
     )
     cmd.add_argument(
         "--phase-stats",
@@ -137,9 +148,10 @@ def run_generate(args) -> int:
     if args.resume and not args.journal:
         # validate before any cluster resources get created
         raise SystemExit("--resume requires --journal")
-    from .probe_cmd import _start_metrics
+    from .probe_cmd import _start_metrics, _start_trace
 
     _start_metrics(args)
+    _start_trace(args)
     namespaces = args.server_namespace or ["x", "y", "z"]
     pods = args.server_pod or ["a", "b", "c"]
     ports = args.server_port or [80, 81]
@@ -167,7 +179,14 @@ def run_generate(args) -> int:
             args, kubernetes, namespaces, pods, ports, protocols, excluded
         )
     finally:
-        close_cluster(kubernetes)
+        # trace first (the run's artifact survives a cleanup failure),
+        # cleanup guaranteed even if the write fails — see run_probe
+        from .probe_cmd import _write_trace
+
+        try:
+            _write_trace(args)
+        finally:
+            close_cluster(kubernetes)
 
 
 def _run_generate_cases(
@@ -245,10 +264,15 @@ def _run_generate_cases(
         if args.resume and journal.completed():
             print(f"resuming: {len(journal.completed())} case(s) already journaled")
 
+    from ..telemetry.spans import span
     from ..utils.tracing import jax_profile, render_stats
 
     failed = 0
-    with jax_profile(args.jax_profile):
+    # generate.run is the timeline's root; interpreter.case / .step /
+    # .probe and the worker's spans all nest under it
+    with jax_profile(args.jax_profile), span(
+        "generate.run", cases=len(cases), engine=args.engine
+    ):
         for i, tc in enumerate(cases):
             # descriptions are not unique across cases; the index in the
             # deterministic generated order disambiguates (see journal.py)

@@ -57,6 +57,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     telemetry_cmd.set_defaults(func=_run_telemetry)
 
+    trace_cmd = sub.add_parser(
+        "trace",
+        help="export this process's trace-event timeline as Chrome "
+        "trace JSON, or summarize one written by --trace-out",
+    )
+    trace_cmd.add_argument(
+        "--input",
+        default="",
+        metavar="PATH",
+        help="summarize a Chrome trace JSON written by --trace-out "
+        "(events per process, wall span, top spans by duration) "
+        "instead of exporting this process's ring",
+    )
+    trace_cmd.add_argument(
+        "--out",
+        default="",
+        metavar="PATH",
+        help="write the export to PATH instead of stdout",
+    )
+    trace_cmd.set_defaults(func=_run_trace)
+
     version_cmd = sub.add_parser("version", help="print version information")
     version_cmd.add_argument(
         "--devices",
@@ -118,6 +139,39 @@ def _run_telemetry(args) -> int:
         print(json.dumps(telemetry.snapshot(), indent=2, default=str))
     else:
         print(telemetry.render_text())
+    return 0
+
+
+def _run_trace(args) -> int:
+    """The timeline sibling of `telemetry`: where that command renders
+    AGGREGATES (span tree, metric families), this one deals in the
+    trace-event TIMELINE (docs/DESIGN.md "Trace timelines") — export the
+    current process's event ring as Chrome trace JSON (mostly useful to
+    tooling embedding the CLI in-process), or summarize a trace file a
+    `probe`/`generate` run wrote via --trace-out."""
+    import json
+
+    from ..telemetry import events, trace_export
+
+    if args.input:
+        with open(args.input) as f:
+            trace = json.load(f)
+        print(trace_export.summarize(trace))
+        return 0
+    if not events.entries():
+        print(
+            "(no trace events recorded in this process: run with "
+            "--trace-out, or CYCLONUS_TRACE_EVENTS=1)",
+            file=sys.stderr,
+        )
+    if args.out:
+        path = trace_export.write_chrome_trace(args.out)
+        print(
+            f"trace: wrote {path} "
+            "(load in https://ui.perfetto.dev or chrome://tracing)"
+        )
+    else:
+        print(json.dumps(trace_export.to_chrome_trace(), default=str))
     return 0
 
 

@@ -87,21 +87,29 @@ class Interpreter:
             steps=len(test_case.steps),
         ):
             for step_index, step in enumerate(test_case.steps):
-                for action_index, action in enumerate(step.actions):
-                    try:
-                        self._apply_action(state, action)
-                    except Exception as e:
-                        logger.error(
-                            "action failed at step %d, action %d: %s",
-                            step_index,
-                            action_index,
-                            e,
-                        )
-                        result.err = e
-                        return result
-                if self.config.perturbation_wait_seconds > 0:
-                    time.sleep(self.config.perturbation_wait_seconds)
-                result.steps.append(self._run_probe(state, step.probe))
+                # per-step annotation: on a trace timeline the case span
+                # divides into its steps (actions + settle wait + probe),
+                # so a 216-case conformance run stays navigable
+                with span(
+                    "interpreter.step",
+                    step=step_index,
+                    actions=len(step.actions),
+                ):
+                    for action_index, action in enumerate(step.actions):
+                        try:
+                            self._apply_action(state, action)
+                        except Exception as e:
+                            logger.error(
+                                "action failed at step %d, action %d: %s",
+                                step_index,
+                                action_index,
+                                e,
+                            )
+                            result.err = e
+                            return result
+                    if self.config.perturbation_wait_seconds > 0:
+                        time.sleep(self.config.perturbation_wait_seconds)
+                    result.steps.append(self._run_probe(state, step.probe))
         return result
 
     def _apply_action(self, state: TestCaseState, action) -> None:

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 from ..kube.ikubernetes import IKubernetes, KubeError
 from ..matcher.core import Policy
 from ..telemetry import instruments as ti
+from ..telemetry import spans
 from ..telemetry.spans import span
 from .connectivity import (
     CONNECTIVITY_ALLOWED,
@@ -331,6 +332,7 @@ class KubeBatchJobRunner(JobRunner):
         self.workers = workers
 
     def run_jobs(self, jobs: List[Job]) -> List[JobResult]:
+        from ..telemetry import events
         from ..worker.model import Batch, Request
 
         job_map: Dict[str, Job] = {}
@@ -352,6 +354,15 @@ class KubeBatchJobRunner(JobRunner):
             )
             job_map[job.key()] = job
 
+        if events.enabled():
+            # trace context crosses the wire on the batch: the parent
+            # path is captured HERE (the issuing step's thread) because
+            # the pool threads below have no span state of their own
+            parent = spans.current_path()
+            for batch in batches.values():
+                batch.trace_id = events.trace_id() or ""
+                batch.parent_span = parent
+
         results: List[JobResult] = []
         if not batches:
             return results
@@ -363,7 +374,18 @@ class KubeBatchJobRunner(JobRunner):
 
     def _run_batch(self, batch):
         try:
-            results = self.client.batch(batch)
+            # re-adopt the issuing step's path on this pool thread so
+            # the driver-side exec span — and, through the refreshed
+            # parent_span, the remote worker's spans — nest under it
+            with spans.adopt(batch.parent_span):
+                with span(
+                    "probe.kube_batch",
+                    pod=batch.key(),
+                    requests=len(batch.requests),
+                ):
+                    if batch.trace_id:
+                        batch.parent_span = spans.current_path()
+                    results = self.client.batch(batch)
         except KubeError:
             return [(r.key, CONNECTIVITY_CHECK_FAILED) for r in batch.requests]
         for r in results:

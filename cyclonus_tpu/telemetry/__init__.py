@@ -15,7 +15,14 @@ first-class layer.  This package is that layer:
                   `eval_flight` wrapper the engine hot paths use
   recorder.py     bounded ring of the last N evaluations, dumped to
                   JSON on unhandled crash and on demand
-  server.py       optional stdlib http.server thread (`--metrics-port`)
+  events.py       trace-event recorder: span enter/exit as timestamped
+                  events in a bounded ring, with (trace_id, parent path)
+                  context propagated driver->worker over the wire
+  trace_export.py Chrome trace-event JSON export of the merged timeline
+                  (Perfetto / chrome://tracing; `--trace-out`, the
+                  `cyclonus-tpu trace` CLI mode)
+  server.py       optional stdlib http.server thread (`--metrics-port`),
+                  plus on-demand device profiling (/profile?seconds=N)
 
 Disable everything with CYCLONUS_TELEMETRY=0 (or `set_enabled(False)`);
 the instrumented paths then cost one attribute read.  Hot-path overhead
@@ -26,7 +33,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import instruments, metrics, recorder, spans, state
+from . import events, instruments, metrics, recorder, spans, state, trace_export
 from .metrics import REGISTRY as METRICS
 from .spans import REGISTRY as SPANS, span
 from .state import enabled, set_enabled
@@ -35,6 +42,7 @@ __all__ = [
     "METRICS",
     "SPANS",
     "enabled",
+    "events",
     "instruments",
     "metrics",
     "recorder",
@@ -46,6 +54,7 @@ __all__ = [
     "span",
     "spans",
     "state",
+    "trace_export",
 ]
 
 
@@ -95,8 +104,10 @@ def render_text() -> str:
 
 
 def reset() -> None:
-    """Zero spans, metric series, and the flight ring (registrations
-    survive).  Bench and tests isolate runs with this."""
+    """Zero spans, metric series, the flight ring, and the trace-event
+    window (registrations and the active-trace state survive).  Bench
+    and tests isolate runs with this."""
     SPANS.reset()
     METRICS.reset()
     recorder.reset()
+    events.reset()

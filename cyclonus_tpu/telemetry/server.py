@@ -1,24 +1,45 @@
 """Optional metrics endpoint: a stdlib http.server thread.
 
-`start_metrics_server(port)` binds 127.0.0.1:<port> (0 = ephemeral) and
-serves, on a daemon thread:
+`start_metrics_server(port)` binds 127.0.0.1:<port> (0 = ephemeral; the
+BOUND port is logged and available as `.port`/`.url` so callers can curl
+it) and serves, on a daemon thread:
 
-    /metrics         Prometheus text exposition (curl-able scrape target)
-    /metrics.json    metrics snapshot as JSON
-    /telemetry.json  full snapshot: metrics + span tree + flight recorder
-    /healthz         200 ok
+    /metrics           Prometheus text exposition (curl-able scrape target)
+    /metrics.json      metrics snapshot as JSON
+    /telemetry.json    full snapshot: metrics + span tree + flight recorder
+    /profile?seconds=N on-demand device profiling: runs jax.profiler.trace
+                       for N seconds into a fresh temp dir and returns the
+                       artifact path as JSON (open in TensorBoard/XProf)
+    /healthz           200 ok
 
 Used by `probe`/`generate`/the worker via `--metrics-port`.  Stdlib-only
 by design (the container bakes no Prometheus client), and the thread is
-a daemon, so a finished CLI run never hangs on it.
+a daemon, so a finished CLI run never hangs on it.  A port that is
+already taken raises MetricsPortBusy with a one-line message (the CLIs
+convert it to a clean exit instead of a traceback).
 """
 
 from __future__ import annotations
 
+import errno
 import json
+import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger("cyclonus.telemetry")
+
+# /profile runs the singleton jax profiler; concurrent captures cannot
+# nest, so a second request while one runs gets 409, not a crash
+_PROFILE_LOCK = threading.Lock()
+PROFILE_MAX_SECONDS = 60.0
+
+
+class MetricsPortBusy(RuntimeError):
+    """The requested metrics port is already bound by another process."""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -29,10 +50,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_json(self, payload: dict, code: int = 200) -> None:
+        self._send(
+            json.dumps(payload, default=str).encode(), "application/json", code
+        )
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         from . import render_prometheus, snapshot
 
-        path = self.path.split("?", 1)[0]
+        parsed = urlparse(self.path)
+        path = parsed.path
         if path == "/metrics":
             self._send(
                 render_prometheus().encode(),
@@ -41,19 +68,57 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics.json":
             from .metrics import REGISTRY
 
-            self._send(
-                json.dumps(REGISTRY.snapshot(), default=str).encode(),
-                "application/json",
-            )
+            self._send_json(REGISTRY.snapshot())
         elif path == "/telemetry.json":
-            self._send(
-                json.dumps(snapshot(), default=str).encode(),
-                "application/json",
-            )
+            self._send_json(snapshot())
+        elif path == "/profile":
+            self._profile(parse_qs(parsed.query))
         elif path == "/healthz":
             self._send(b"ok\n", "text/plain")
         else:
             self._send(b"not found\n", "text/plain", 404)
+
+    def _profile(self, query: dict) -> None:
+        """On-demand device profiling: wrap a sleep of ?seconds=N in
+        jax.profiler.trace (via the utils/tracing.jax_profile wrapper the
+        --jax-profile flags already use) and report the artifact dir.
+        The handler blocks for the capture window — ThreadingHTTPServer
+        keeps the other endpoints responsive meanwhile."""
+        try:
+            seconds = float(query.get("seconds", ["1"])[0])
+        except (TypeError, ValueError):
+            self._send_json({"error": "seconds must be a number"}, 400)
+            return
+        if not (0 < seconds <= PROFILE_MAX_SECONDS):
+            self._send_json(
+                {"error": f"seconds must be in (0, {PROFILE_MAX_SECONDS:g}]"},
+                400,
+            )
+            return
+        if not _PROFILE_LOCK.acquire(blocking=False):
+            self._send_json({"error": "a profile capture is already running"}, 409)
+            return
+        try:
+            import tempfile
+
+            from ..utils.tracing import jax_profile
+
+            out_dir = tempfile.mkdtemp(prefix="cyclonus-profile-")
+            t0 = time.time()
+            with jax_profile(out_dir):
+                time.sleep(seconds)
+            self._send_json(
+                {
+                    "artifact": out_dir,
+                    "seconds": seconds,
+                    "wall_s": round(time.time() - t0, 3),
+                    "hint": "open with: tensorboard --logdir <artifact>",
+                }
+            )
+        except Exception as e:  # a failed capture must answer, not hang
+            self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+        finally:
+            _PROFILE_LOCK.release()
 
     def log_message(self, format: str, *args) -> None:
         pass  # scrapes must not spam the CLI's stdout
@@ -61,7 +126,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MetricsServer:
     def __init__(self, port: int, host: str = "127.0.0.1"):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        except OSError as e:
+            if e.errno == errno.EADDRINUSE:
+                raise MetricsPortBusy(
+                    f"metrics port {port} is already in use on {host} — "
+                    "pass a free port, or 0 for an ephemeral one"
+                ) from None
+            raise
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]
@@ -71,6 +144,9 @@ class MetricsServer:
             daemon=True,
         )
         self._thread.start()
+        # with port 0 the OS picked; the log line is how users learn
+        # where to curl
+        logger.info("metrics server bound on %s", self.url)
 
     @property
     def url(self) -> str:
@@ -88,7 +164,8 @@ _ACTIVE: dict = {"server": None}
 def start_metrics_server(port: int, host: str = "127.0.0.1") -> MetricsServer:
     """Start (or return the already-running) metrics server.  One per
     process: a second call with a different port replaces nothing — the
-    live server wins, matching the process-global registry it serves."""
+    live server wins, matching the process-global registry it serves.
+    Raises MetricsPortBusy (one clean line) when the port is taken."""
     srv = _ACTIVE["server"]
     if srv is not None:
         return srv

@@ -31,7 +31,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
-from . import state
+from . import events, state
 
 logger = logging.getLogger("cyclonus.trace")
 
@@ -153,6 +153,21 @@ def current_path() -> str:
 
 
 @contextlib.contextmanager
+def adopt(path: str) -> Iterator[None]:
+    """Adopt a foreign span path as this thread's parent, so subsequent
+    spans nest under it.  Two users: worker threads inheriting the
+    issuing thread's path (pool.map drops thread-locals), and the remote
+    worker adopting the DRIVER's path off the wire (worker/model.py
+    Batch.parent_span) so a merged trace renders as one tree."""
+    prev = getattr(_tls, "path", "")
+    _tls.path = path or ""
+    try:
+        yield
+    finally:
+        _tls.path = prev
+
+
+@contextlib.contextmanager
 def span(name: str, **attrs: Any) -> Iterator[Span]:
     """Time a block as a child of the current thread's active span."""
     if not state.ENABLED:
@@ -162,6 +177,8 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
     path = f"{parent}/{name}" if parent else name
     _tls.path = path
     handle = Span(name, path, attrs)
+    if events.ACTIVE:
+        events.record("B", name, path, attrs)
     t0 = time.perf_counter()
     try:
         yield handle
@@ -169,4 +186,7 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
         dt = time.perf_counter() - t0
         _tls.path = parent
         REGISTRY.record(path, name, dt, handle.attrs)
+        if events.ACTIVE:
+            # exit carries the FINAL attrs (s.set() calls inside the block)
+            events.record("E", name, path, handle.attrs)
         logger.debug("phase %s: %.4fs", path, dt)

@@ -26,4 +26,13 @@ class Client:
             parsed = json.loads(stdout) if stdout.strip() else []
         except json.JSONDecodeError as e:
             raise KubeError(f"unable to parse worker output: {e}")
-        return [Result.from_dict(d) for d in parsed]
+        results = [Result.from_dict(d) for d in parsed]
+        if batch.trace_id:
+            # merge the worker's recorded events into the driver's
+            # timeline (in-process workers are deduped by pid in ingest)
+            from ..telemetry import events
+
+            for r in results:
+                if r.trace_events:
+                    events.ingest(r.trace_events)
+        return results

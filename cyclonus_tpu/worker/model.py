@@ -1,10 +1,32 @@
-"""Batch/Request/Result wire model (reference: worker/model.go)."""
+"""Batch/Request/Result wire model (reference: worker/model.go).
+
+Wire-protocol compatibility rules — THE one place they are stated (both
+directions are asserted by tests/test_worker.py):
+
+  * The reference shape (Namespace/Pod/Container/Requests; Request/
+    Output/Error) is frozen: those keys are always emitted, so an old
+    (even Go) consumer keeps parsing.
+  * Every extension is an OPTIONAL field: serialization omits it when
+    unset (`to_dict`/`to_json` emit no key), and parsing treats a
+    missing key as the unset default (`.get`).  Old workers simply never
+    emit it; old drivers never look for it.
+  * Unknown keys are TOLERATED on parse: `from_dict`/`from_json` read
+    the keys they know and ignore the rest, so a NEWER peer's extra
+    fields never break an older one.
+  * Extensions so far: Result.LatencyMs (per-probe wall-clock, feeds the
+    driver's cyclonus_tpu_probe_latency_seconds histogram),
+    Batch.TraceId + Batch.ParentSpan (driver->worker trace context:
+    the worker records its spans under the driver's trace id, nested
+    under the driver's span path), and Result.TraceEvents (the worker's
+    recorded events riding back to the driver for the merged timeline —
+    telemetry/events.py).
+"""
 
 from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -46,25 +68,35 @@ class Request:
 
 @dataclass
 class Batch:
-    """model.go:9-24."""
+    """model.go:9-24.
+
+    trace_id / parent_span are OPTIONAL trace context (see the module
+    docstring's compatibility rules): when the driver is recording a
+    timeline, it stamps its trace id and current span path here so the
+    worker's spans join the same trace, nested under the issuing step."""
 
     namespace: str
     pod: str
     container: str
     requests: List[Request] = field(default_factory=list)
+    trace_id: str = ""
+    parent_span: str = ""
 
     def key(self) -> str:
         return f"{self.namespace}/{self.pod}/{self.container}"
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "Namespace": self.namespace,
-                "Pod": self.pod,
-                "Container": self.container,
-                "Requests": [r.to_dict() for r in self.requests],
-            }
-        )
+        d: Dict[str, Any] = {
+            "Namespace": self.namespace,
+            "Pod": self.pod,
+            "Container": self.container,
+            "Requests": [r.to_dict() for r in self.requests],
+        }
+        if self.trace_id:
+            d["TraceId"] = self.trace_id
+            if self.parent_span:
+                d["ParentSpan"] = self.parent_span
+        return json.dumps(d)
 
     @staticmethod
     def from_json(text: str) -> "Batch":
@@ -74,6 +106,8 @@ class Batch:
             pod=d.get("Pod", ""),
             container=d.get("Container", ""),
             requests=[Request.from_dict(r) for r in d.get("Requests") or []],
+            trace_id=d.get("TraceId", "") or "",
+            parent_span=d.get("ParentSpan", "") or "",
         )
 
 
@@ -81,36 +115,41 @@ class Batch:
 class Result:
     """model.go:50-61.
 
-    latency_ms is new vs the reference: per-probe wall-clock measured by
-    the worker (worker.py _issue_one), the data source for the driver's
-    real-probe latency histogram.  It is OPTIONAL on the wire in both
-    directions — old workers omit it, old drivers ignore the extra key —
-    so the JSON stays backward-compatible."""
+    latency_ms and trace_events are optional extensions (module
+    docstring): per-probe wall-clock measured by the worker
+    (worker.py _issue_one) feeding the driver's real-probe latency
+    histogram, and the worker's recorded trace events riding back for
+    the merged driver+worker timeline."""
 
     request: Request
     output: str = ""
     error: str = ""
     latency_ms: Optional[float] = None
+    trace_events: Optional[List[Dict[str, Any]]] = None
 
     def is_success(self) -> bool:
         return self.error == ""
 
     def to_dict(self) -> dict:
-        d = {
+        d: Dict[str, Any] = {
             "Request": self.request.to_dict(),
             "Output": self.output,
             "Error": self.error,
         }
         if self.latency_ms is not None:
             d["LatencyMs"] = self.latency_ms
+        if self.trace_events:
+            d["TraceEvents"] = self.trace_events
         return d
 
     @staticmethod
     def from_dict(d: dict) -> "Result":
         latency = d.get("LatencyMs")
+        events = d.get("TraceEvents")
         return Result(
             request=Request.from_dict(d["Request"]),
             output=d.get("Output", ""),
             error=d.get("Error", ""),
             latency_ms=float(latency) if latency is not None else None,
+            trace_events=list(events) if events else None,
         )

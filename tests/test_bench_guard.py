@@ -121,6 +121,28 @@ class TestBenchGuards:
         assert leg["unit"] == "cells/sec"
         assert "128 pods" in leg["metric"]
 
+    def test_trace_dir_records_written_artifact(self, tmp_path):
+        """BENCH_TRACE_DIR (= bench.py --trace-dir) wraps the eval phase
+        in jax.profiler.trace; the JSON line's detail.trace block must
+        point at the dir and confirm the profiler left an artifact."""
+        cap_dir = str(tmp_path / "cap")
+        proc = run_bench(
+            {
+                "BENCH_TRACE_DIR": cap_dir,
+                "BENCH_PODS": "64",
+                "BENCH_POLICIES": "8",
+                "BENCH_SAMPLE": "3",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+                "BENCH_COUNTS_BACKEND": "xla",
+            },
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-500:]
+        out = last_json_line(proc.stdout)
+        assert out["detail"]["trace"] == {"dir": cap_dir, "written": True}
+        assert any(files for _, _, files in os.walk(cap_dir))
+
     def test_success_line_parses_with_detail_blocks(self):
         proc = run_bench(
             {
@@ -156,3 +178,7 @@ class TestBenchGuards:
         # happens before the warmup-start reset, so dispatch is the
         # marker phase)
         assert "engine.dispatch" in detail["warmup_phases"]
+        # every BENCH line must record its device-profile provenance:
+        # whether a --trace-dir/BENCH_TRACE_DIR jax-profiler artifact
+        # was written this run (here: no capture requested)
+        assert detail["trace"] == {"dir": None, "written": False}

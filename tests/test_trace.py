@@ -1,0 +1,473 @@
+"""Trace-timeline layer tests (docs/DESIGN.md "Trace timelines"):
+
+  * event recorder: span enter/exit captured as B/E events, bounded
+    ring, enable/disable semantics, in-process ingest dedup;
+  * Chrome trace-event export golden shape: required keys on every
+    event (ph/ts/pid/tid/name), balanced + monotonically consistent B/E
+    pairs, normalized timestamps, process-name metadata;
+  * driver→worker context propagation: an in-process batch round trip
+    and a REAL worker subprocess both share the driver's trace_id and
+    nest under the issuing step's span path;
+  * acceptance: `probe --mock --trace-out` writes a loadable Chrome
+    trace; `/profile?seconds=N` on the metrics server returns 200 with
+    a written profiler artifact;
+  * `cyclonus-tpu trace` CLI export + summary modes;
+  * metrics server: ephemeral port is reported; a taken port fails with
+    MetricsPortBusy / one clean CLI line, not a traceback.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cyclonus_tpu import telemetry
+from cyclonus_tpu.telemetry import events, trace_export
+from cyclonus_tpu.telemetry.spans import adopt, span
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    """Every test starts untraced and leaves nothing active."""
+    events.disable()
+    events.reset()
+    yield
+    events.disable()
+    events.reset()
+
+
+def validate_chrome_trace(trace):
+    """The golden-shape contract: required keys, and per-(pid, tid)
+    balanced B/E pairs whose timestamps are monotonically consistent
+    (each E closes the latest open B of the same name, never earlier
+    than it)."""
+    assert "traceEvents" in trace and "displayTimeUnit" in trace
+    stacks = {}
+    spans = 0
+    for e in trace["traceEvents"]:
+        for key in trace_export.CHROME_EVENT_KEYS:
+            assert key in e, f"event missing {key}: {e}"
+        if e["ph"] == "M":
+            continue
+        assert e["ph"] in ("B", "E"), f"unexpected phase {e['ph']}"
+        assert e["ts"] >= 0
+        stack = stacks.setdefault((e["pid"], e["tid"]), [])
+        if e["ph"] == "B":
+            stack.append(e)
+        else:
+            assert stack, f"E without open B on {(e['pid'], e['tid'])}: {e}"
+            b = stack.pop()
+            assert b["name"] == e["name"], f"mismatched pair {b} / {e}"
+            assert e["ts"] >= b["ts"], f"E before B: {b} / {e}"
+            spans += 1
+    for key, stack in stacks.items():
+        assert not stack, f"unclosed B events on {key}: {stack}"
+    return spans
+
+
+class TestEventRecorder:
+    def test_disabled_by_default_and_costs_nothing(self):
+        with span("ev.off"):
+            pass
+        assert events.entries() == []
+
+    def test_span_enter_exit_captured_with_final_attrs(self):
+        tid = events.enable()
+        with span("ev.outer", pods=3) as s:
+            with span("ev.inner"):
+                pass
+            s.set(targets=9)
+        evts = events.entries()
+        assert [e["ph"] for e in evts] == ["B", "B", "E", "E"]
+        assert [e["name"] for e in evts] == [
+            "ev.outer", "ev.inner", "ev.inner", "ev.outer",
+        ]
+        assert evts[1]["path"] == "ev.outer/ev.inner"
+        assert all(e["trace_id"] == tid for e in evts)
+        assert all(e["pid"] == os.getpid() for e in evts)
+        # B carries entry attrs; E carries the final (s.set-enriched) view
+        assert evts[0]["args"] == {"pods": 3}
+        assert evts[3]["args"] == {"pods": 3, "targets": 9}
+        assert evts[3]["ts"] >= evts[0]["ts"]
+
+    def test_ring_is_bounded_newest_wins(self):
+        events.enable()
+        cap = events.RING.maxlen
+        for i in range(cap + 10):
+            events.record("B", f"n{i}", f"n{i}")
+        assert len(events.entries()) == cap
+        assert events.entries()[-1]["name"] == f"n{cap + 9}"
+
+    def test_ingest_skips_own_pid_and_junk(self):
+        events.enable("t1")
+        with span("ev.mine"):
+            pass
+        own = events.entries()
+        assert events.ingest(own) == 0  # in-process worker dedup
+        foreign = [dict(e, pid=os.getpid() + 1) for e in own]
+        assert events.ingest(foreign) == 2
+        assert events.ingest([{"ph": "B"}, "junk", 42]) == 0
+        assert len(events.entries()) == 4
+
+    def test_mark_since_window(self):
+        events.enable()
+        with span("ev.before"):
+            pass
+        marker = events.mark()
+        with span("ev.after"):
+            pass
+        new = events.since(marker)
+        assert [e["name"] for e in new] == ["ev.after", "ev.after"]
+        assert events.since(events.mark()) == []
+
+    def test_adopt_nests_under_foreign_path(self):
+        events.enable()
+        with adopt("driver/step-3"):
+            with span("ev.child"):
+                pass
+        assert events.entries()[0]["path"] == "driver/step-3/ev.child"
+        # and the thread's path is restored
+        with span("ev.top"):
+            pass
+        assert events.entries()[-1]["path"] == "ev.top"
+
+
+class TestChromeExport:
+    def test_golden_shape_and_pair_consistency(self):
+        events.enable("shape-test")
+        with span("exp.a", x=1):
+            with span("exp.b"):
+                pass
+        trace = trace_export.to_chrome_trace()
+        assert validate_chrome_trace(trace) == 2
+        # JSON-serializable end to end
+        rt = json.loads(json.dumps(trace))
+        names = [e["name"] for e in rt["traceEvents"] if e["ph"] != "M"]
+        assert names == ["exp.a", "exp.b", "exp.b", "exp.a"]
+        # normalized timestamps: first event at 0, origin preserved
+        first = [e for e in rt["traceEvents"] if e["ph"] == "B"][0]
+        assert first["ts"] == 0.0
+        assert rt["otherData"]["epoch_origin_s"] > 0
+        assert rt["otherData"]["trace_id"] == "shape-test"
+        # args carry the span path for navigation
+        assert first["args"]["path"] == "exp.a"
+        # process-name metadata row present
+        metas = [e for e in rt["traceEvents"] if e["ph"] == "M"]
+        assert metas and "driver" in metas[0]["args"]["name"]
+
+    def test_trace_id_filter(self):
+        events.enable("keep")
+        with span("exp.keep"):
+            pass
+        events.ingest(
+            [
+                {
+                    "ph": "B", "name": "exp.drop", "path": "exp.drop",
+                    "ts": 1.0, "pid": os.getpid() + 1, "tid": 1,
+                    "trace_id": "other",
+                }
+            ]
+        )
+        trace = trace_export.to_chrome_trace(trace_id="keep")
+        names = {e["name"] for e in trace["traceEvents"] if e["ph"] != "M"}
+        assert names == {"exp.keep"}
+
+    def test_write_and_summarize(self, tmp_path):
+        events.enable()
+        with span("exp.w"):
+            pass
+        p = trace_export.write_chrome_trace(str(tmp_path / "t.json"))
+        data = json.load(open(p))
+        validate_chrome_trace(data)
+        text = trace_export.summarize(data)
+        assert "exp.w" in text and "1 process(es)" in text
+        assert trace_export.summarize({"traceEvents": []}).startswith(
+            "(empty trace"
+        )
+
+
+class _WorkerKube:
+    """IKubernetes stub whose exec runs the REAL in-process worker, so a
+    driver-side batch runner round-trips through the actual wire JSON."""
+
+    def execute_remote_command(self, namespace, pod, container, command):
+        from cyclonus_tpu.worker.worker import run_worker
+
+        return run_worker(command[command.index("--jobs") + 1]), "", None
+
+
+class TestContextPropagation:
+    def _jobs(self):
+        from cyclonus_tpu.probe.job import Job
+
+        return [
+            Job(
+                from_key="x/a", from_namespace="x", from_pod="a",
+                from_container="cont", to_key="x/b", to_host="127.0.0.1",
+                to_namespace="x", resolved_port=1,
+                resolved_port_name="p", protocol="TCP",
+            )
+        ]
+
+    def test_in_process_roundtrip_single_trace_single_ring(self, monkeypatch):
+        """Worker spans join the driver's trace_id, nest under the
+        issuing step's span path, and are NOT duplicated by ingest when
+        the worker ran in-process."""
+        from cyclonus_tpu.probe.runner import KubeBatchJobRunner
+        from cyclonus_tpu.worker import worker as worker_mod
+        from cyclonus_tpu.worker.model import Result
+
+        monkeypatch.setattr(
+            worker_mod,
+            "_probe_with_retries",
+            lambda request: Result(request=request, output="connected"),
+        )
+        driver_tid = events.enable()
+        runner = KubeBatchJobRunner(_WorkerKube())
+        with span("interpreter.step", step=0):
+            results = runner.run_jobs(self._jobs())
+        assert [r.combined for r in results] == ["allowed"]
+        evts = events.entries()
+        assert all(e["trace_id"] == driver_tid for e in evts)
+        worker_evts = [e for e in evts if e["name"].startswith("worker.")]
+        assert {e["name"] for e in worker_evts} == {
+            "worker.batch", "worker.probe",
+        }
+        # nesting: worker spans sit under the driver's step span path
+        assert all(
+            e["path"].startswith("interpreter.step/probe.kube_batch/")
+            for e in worker_evts
+        )
+        # no duplication: exactly one B per span occurrence
+        probe_b = [
+            e for e in worker_evts
+            if e["name"] == "worker.probe" and e["ph"] == "B"
+        ]
+        assert len(probe_b) == 1
+        # the in-process worker must NOT have flipped the process-global
+        # role: driver events recorded after the batch stay "driver"
+        with span("post.batch"):
+            pass
+        assert events.entries()[-1]["role"] == "driver"
+        validate_chrome_trace(trace_export.to_chrome_trace())
+
+    def test_subprocess_worker_shares_trace_and_merges(self):
+        """Acceptance: a REAL worker subprocess records events under the
+        driver's trace_id in its own pid, ships them back on the Result
+        wire, and the merged export shows both processes."""
+        from cyclonus_tpu.worker.model import Batch, Request, Result
+
+        driver_tid = events.enable()
+        with span("interpreter.step", step=0):
+            parent = "interpreter.step"
+            batch = Batch(
+                namespace="x", pod="a", container="c",
+                requests=[
+                    Request(
+                        key="x/a->x/b", protocol="tcp",
+                        host="127.0.0.1", port=1,
+                    )
+                ],
+                trace_id=driver_tid,
+                parent_span=parent,
+            )
+            env = dict(os.environ, CYCLONUS_CONNECT_NATIVE="1")
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "cyclonus_tpu.worker",
+                    "--jobs", batch.to_json(),
+                ],
+                capture_output=True, text=True, timeout=120,
+                cwd=REPO, env=env,
+            )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        results = [Result.from_dict(d) for d in json.loads(proc.stdout)]
+        shipped = results[0].trace_events
+        assert shipped, "worker shipped no trace events"
+        assert all(e["trace_id"] == driver_tid for e in shipped)
+        assert all(e["pid"] != os.getpid() for e in shipped)
+        assert all(e["role"] == "worker" for e in shipped)
+        assert all(e["path"].startswith("interpreter.step/") for e in shipped)
+        assert events.ingest(shipped) == len(shipped)
+        trace = trace_export.to_chrome_trace(trace_id=driver_tid)
+        validate_chrome_trace(trace)
+        pids = {e["pid"] for e in trace["traceEvents"] if e["ph"] != "M"}
+        assert len(pids) == 2, "merged trace must span driver + worker pids"
+        metas = {
+            e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert any("driver" in m for m in metas)
+        assert any("worker" in m for m in metas)
+
+
+class TestProbeTraceOutAcceptance:
+    def test_probe_trace_out_writes_merged_chrome_trace(self, tmp_path):
+        """Acceptance: a simulated probe run with --trace-out produces
+        Chrome trace-event JSON whose driver events share one trace_id
+        and include the case/step/probe/engine span hierarchy."""
+        from cyclonus_tpu.cli.root import main
+
+        out = str(tmp_path / "run.json")
+        rc = main(
+            [
+                "probe", "--mock", "--perfect-cni", "--ignore-loopback",
+                "--trace-out", out,
+            ]
+        )
+        assert rc == 0
+        trace = json.load(open(out))
+        assert validate_chrome_trace(trace) > 0
+        names = {e["name"] for e in trace["traceEvents"] if e["ph"] != "M"}
+        for expected in (
+            "probe.run", "interpreter.case", "interpreter.step",
+            "interpreter.probe", "probe.simulated",
+        ):
+            assert expected in names, f"{expected} missing from timeline"
+        ids = {
+            e["args"].get("trace_id")
+            for e in trace["traceEvents"]
+            if e["ph"] != "M" and e["args"].get("trace_id")
+        }
+        assert trace["otherData"]["trace_id"] is not None
+        assert len(trace["otherData"]["trace_ids"]) == 1
+        # nested paths are navigable: the probe span sits under the run
+        probe_paths = [
+            e["args"]["path"]
+            for e in trace["traceEvents"]
+            if e.get("name") == "interpreter.probe"
+        ]
+        assert probe_paths and all(
+            p.startswith("probe.run/interpreter.case/interpreter.step")
+            for p in probe_paths
+        )
+
+
+class TestProfileEndpoint:
+    def test_profile_returns_artifact(self):
+        """Acceptance: /profile?seconds=N returns 200 with a profiler
+        artifact directory that exists and contains capture files."""
+        from cyclonus_tpu.telemetry.server import (
+            start_metrics_server,
+            stop_metrics_server,
+        )
+
+        srv = start_metrics_server(0)
+        try:
+            assert srv.port != 0  # the BOUND ephemeral port is reported
+            with urllib.request.urlopen(
+                srv.url + "/profile?seconds=0.2", timeout=180
+            ) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+            assert body["seconds"] == 0.2
+            artifact = body["artifact"]
+            assert os.path.isdir(artifact)
+            files = [
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(artifact)
+                for f in fs
+            ]
+            assert files, "profiler left no artifact files"
+        finally:
+            stop_metrics_server()
+
+    def test_profile_rejects_bad_seconds(self):
+        from cyclonus_tpu.telemetry.server import (
+            start_metrics_server,
+            stop_metrics_server,
+        )
+
+        srv = start_metrics_server(0)
+        try:
+            for q in ("seconds=abc", "seconds=0", "seconds=9999"):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(
+                        f"{srv.url}/profile?{q}", timeout=30
+                    )
+                assert exc.value.code == 400
+        finally:
+            stop_metrics_server()
+
+
+class TestMetricsPortBusy:
+    def test_server_raises_one_line_error(self):
+        from cyclonus_tpu.telemetry.server import (
+            MetricsPortBusy,
+            MetricsServer,
+        )
+
+        first = MetricsServer(0)
+        try:
+            with pytest.raises(MetricsPortBusy) as exc:
+                MetricsServer(first.port)
+            msg = str(exc.value)
+            assert str(first.port) in msg and "\n" not in msg
+        finally:
+            first.close()
+
+    def test_cli_exits_cleanly_on_taken_port(self):
+        from cyclonus_tpu.cli.probe_cmd import _start_metrics
+        from cyclonus_tpu.telemetry.server import (
+            MetricsServer,
+            active_server,
+        )
+
+        assert active_server() is None, "leaked metrics server"
+        blocker = MetricsServer(0)
+        try:
+            args = type("A", (), {"metrics_port": blocker.port})()
+            with pytest.raises(SystemExit) as exc:
+                _start_metrics(args)
+            assert "already in use" in str(exc.value)
+        finally:
+            blocker.close()
+
+
+class TestTraceCLI:
+    def test_export_and_summary_modes(self, tmp_path, capsys):
+        from cyclonus_tpu.cli.root import main
+
+        events.enable("cli-test")
+        with span("cli.span"):
+            pass
+        out = str(tmp_path / "cli.json")
+        assert main(["trace", "--out", out]) == 0
+        capsys.readouterr()
+        trace = json.load(open(out))
+        validate_chrome_trace(trace)
+        assert main(["trace", "--input", out]) == 0
+        text = capsys.readouterr().out
+        assert "cli.span" in text and "trace_id=cli-test" in text
+
+    def test_stdout_export_is_valid_json(self, capsys):
+        from cyclonus_tpu.cli.root import main
+
+        events.enable()
+        with span("cli.stdout"):
+            pass
+        assert main(["trace"]) == 0
+        trace = json.loads(capsys.readouterr().out)
+        validate_chrome_trace(trace)
+
+
+class TestResetSemantics:
+    def test_telemetry_reset_clears_event_window(self):
+        events.enable()
+        with span("rst.a"):
+            pass
+        assert events.entries()
+        telemetry.reset()
+        assert events.entries() == []
+        # the trace stays ACTIVE: reset starts an empty timeline, not an
+        # untraced process
+        with span("rst.b"):
+            pass
+        assert [e["name"] for e in events.entries()] == ["rst.b", "rst.b"]

@@ -50,6 +50,70 @@ class TestModel:
             Request(key="k", protocol="icmp", host="h", port=80).command()
 
 
+class TestWireCompatibility:
+    """Both directions of the optional-field policy documented in
+    worker/model.py: optional keys are omitted when unset and tolerated
+    when missing, and unknown keys from a NEWER peer are ignored."""
+
+    def test_old_peer_json_still_parses(self):
+        # direction 1: an OLD peer omits every extension — the frozen
+        # reference keys alone must parse, extensions defaulting to unset
+        legacy_result = Result.from_dict(
+            {
+                "Request": {
+                    "Key": "k", "Protocol": "tcp", "Host": "h", "Port": 1,
+                },
+                "Output": "",
+                "Error": "",
+            }
+        )
+        assert legacy_result.latency_ms is None
+        assert legacy_result.trace_events is None
+        legacy_batch = Batch.from_json(
+            '{"Namespace":"x","Pod":"a","Container":"c","Requests":[]}'
+        )
+        assert legacy_batch.trace_id == "" and legacy_batch.parent_span == ""
+
+    def test_unset_extensions_are_omitted_on_the_wire(self):
+        # direction 1 (writer side): we never emit unset optional keys,
+        # so an old consumer sees exactly the frozen reference shape
+        r = Result(request=make_batch().requests[0])
+        assert set(r.to_dict().keys()) == {"Request", "Output", "Error"}
+        b = make_batch()
+        assert set(json.loads(b.to_json()).keys()) == {
+            "Namespace", "Pod", "Container", "Requests",
+        }
+        # ParentSpan rides only alongside TraceId (context is one unit)
+        b.parent_span = "orphan"
+        assert "ParentSpan" not in json.loads(b.to_json())
+
+    def test_unknown_fields_from_newer_peer_are_ignored(self):
+        # direction 2: a NEWER peer's extra keys must not break us
+        d = Result(request=make_batch().requests[0], output="ok").to_dict()
+        d["FutureField"] = {"nested": True}
+        d["Request"]["FutureKey"] = 1
+        parsed = Result.from_dict(d)
+        assert parsed.output == "ok" and parsed.is_success()
+        bd = json.loads(make_batch().to_json())
+        bd["FutureBatchField"] = [1, 2, 3]
+        assert Batch.from_json(json.dumps(bd)) == make_batch()
+
+    def test_set_extensions_roundtrip(self):
+        b = make_batch(1)
+        b.trace_id, b.parent_span = "t123", "interpreter.step"
+        b2 = Batch.from_json(b.to_json())
+        assert b2.trace_id == "t123" and b2.parent_span == "interpreter.step"
+        r = Result(
+            request=b.requests[0],
+            latency_ms=7.25,
+            trace_events=[{"ph": "B", "name": "n", "path": "n", "ts": 1.0,
+                           "pid": 9, "tid": 1}],
+        )
+        r2 = Result.from_dict(r.to_dict())
+        assert r2.latency_ms == 7.25
+        assert r2.trace_events == r.trace_events
+
+
 class _FakeProc:
     def __init__(self, returncode=0, stdout="CONNECTED", stderr=""):
         self.returncode = returncode
