@@ -4,20 +4,23 @@
 test:
 	python -m pytest tests/ -q
 
-# static lint: ruff (when installed) + the JAX hot-path lint over the
-# engine, telemetry, and worker packages (tools/jaxlint.py —
-# device-sync / traced-branch / recompile-risk checks; see
-# docs/DESIGN.md).  Telemetry — including the trace-timeline modules
-# events.py/trace_export.py — and the worker (which now records trace
-# events on the probe path) are linted so instrumentation can never
-# smuggle a device sync into a hot path (tests/test_telemetry.py
-# asserts the same).
+# static lint: ruff (when installed; pinned by [tool.ruff] in
+# pyproject.toml so the installed branch is deterministic) + the JAX
+# hot-path lint (tools/jaxlint.py — device-sync / traced-branch /
+# recompile-risk / host-callback checks) over every package that stages
+# jit code: engine, telemetry, worker, analysis, probe — so
+# instrumentation and audit passes can never smuggle a device sync into
+# a hot path (tests/test_telemetry.py asserts the same) + the
+# lock-discipline lint (tools/locklint.py — guarded-by, lock-order
+# cycles, leaked guards; see docs/DESIGN.md "Lock discipline") over the
+# whole package.
 lint:
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
-	  cyclonus_tpu/worker
+	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe
+	python tools/locklint.py cyclonus_tpu
 
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, then run the suite on a
@@ -33,6 +36,14 @@ conformance:
 # and the xla/pallas counts engines
 fuzz:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fuzz
+
+# opt-in: the extended schedule-fuzzing race sweep (tests/raceharness.py
+# at 16 threads x 200 seeded schedules, runtime lock guards asserting;
+# the 8-thread/50-schedule gate already runs in tier-1 via
+# tests/test_locklint.py)
+race:
+	CYCLONUS_GUARD_CHECK=1 JAX_PLATFORMS=cpu python -m tests.raceharness \
+	  --schedules 200 --threads 16 --seed 99 --verbose
 
 bench:
 	python bench.py
@@ -50,4 +61,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz bench fmt vet lint cyclonus docker
+.PHONY: test check conformance fuzz race bench fmt vet lint cyclonus docker

@@ -19,6 +19,7 @@ import numpy as np
 from ..kube.ipaddr import is_ip_address_match_for_ip_block
 from ..matcher.core import Policy
 from ..telemetry import instruments as ti
+from ..utils import guards
 from ..utils.tracing import phase
 from .encoding import PEER_IP, PolicyEncoding, _DirectionEncoding, encode_policy
 
@@ -590,9 +591,27 @@ def _pack_tensors(tree):
 
 
 
+@guards.checked
 class TpuPolicyEngine:
     """Compile once per (policy set, cluster state); evaluate many port
-    cases.  Pods are (namespace, name, labels, ip) tuples."""
+    cases.  Pods are (namespace, name, labels, ip) tuples.
+
+    Threading model (docs/DESIGN.md "Lock discipline"): evaluations are
+    issued from one thread at a time, but the autotune's abandoned
+    candidate thread (run_bounded timeout) can outlive its call and race
+    the issuing thread inside _slab_ops_for.  Everything that pair of
+    threads shares for WRITING — the slab choice and the cached
+    gathered operands — is guarded by _slab_lock; _pre_cache is written
+    only by the issuing thread, and the one place the orphan reads it
+    (_slab_ops_for's operand build) snapshots it once and treats a
+    concurrent eviction as a contained candidate failure.  The rest of
+    the per-engine caches stay single-threaded by contract.
+    """
+
+    # the guarded-by contract (tools/locklint.py LK001 statically; under
+    # CYCLONUS_GUARD_CHECK=1 these become asserting descriptors)
+    _slab_choice = guards.Guarded("_slab_lock")
+    _slab_ops_cache = guards.Guarded("_slab_lock")
 
     def __init__(
         self,
@@ -644,7 +663,7 @@ class TpuPolicyEngine:
         # guards the (_slab_choice, _slab_ops_cache) pair: the autotune's
         # rejection writes and the ops-cache fill can race an abandoned
         # candidate thread still inside _slab_ops_for
-        self._slab_lock = threading.Lock()
+        self._slab_lock = guards.lock()
         self._counts_packed_jit = None
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
@@ -975,7 +994,8 @@ class TpuPolicyEngine:
             # forced mode skips the autotune; set the choice only now
             # that the plan is actually accepted (a stale True with no
             # plan would break the invariant autotune readers rely on)
-            self._slab_choice = True
+            with self._slab_lock:
+                self._slab_choice = True
         return plan
 
     def _drain_autotune_orphan(self) -> None:
@@ -1113,9 +1133,10 @@ class TpuPolicyEngine:
             )
             return out_default
         t_slab, out_slab = value
+        chose_slab = bool(t_slab < 0.9 * t_default)
         with self._slab_lock:
-            self._slab_choice = bool(t_slab < 0.9 * t_default)
-            if not self._slab_choice:
+            self._slab_choice = chose_slab
+            if not chose_slab:
                 # a timing-rejected slab never dispatches again: its
                 # cached operands (up to the slab byte budget of HBM)
                 # must not stay pinned next to the precompute
@@ -1125,15 +1146,15 @@ class TpuPolicyEngine:
             "slab_s": round(t_slab, 4),
         }
         ti.AUTOTUNE_OUTCOMES.inc(
-            outcome="slab" if self._slab_choice else "default"
+            outcome="slab" if chose_slab else "default"
         )
         logging.getLogger(__name__).info(
             "slab autotune: default %.4fs, slab %.4fs -> %s",
             t_default,
             t_slab,
-            "slab" if self._slab_choice else "default",
+            "slab" if chose_slab else "default",
         )
-        return out_slab if self._slab_choice else out_default
+        return out_slab if chose_slab else out_default
 
     def _build_counts_jits(self) -> None:
         """Build the three counts programs once per engine: the fused
@@ -1275,7 +1296,7 @@ class TpuPolicyEngine:
         self._drain_autotune_orphan()
         from .pallas_kernel import sum_partials
 
-        key, slab_ok, slab_args, (q_port, q_name, q_proto) = (
+        key, slab_ok, slab_args, (q_port, q_name, q_proto), slab_choice = (
             self._steady_state_args(cases)
         )
         t_dispatch = time.perf_counter()
@@ -1285,7 +1306,7 @@ class TpuPolicyEngine:
             self._pre_cache_misses = 0
             ti.PRE_CACHE_HITS.inc()
             fl.set(mode="steady", slab=slab_args[0] is not None)
-            if slab_ok and self._slab_choice is None:
+            if slab_ok and slab_choice is None:
                 autotuned = True
                 # autotune at the first steady-state call: both programs
                 # run from the SAME pinned precompute, so this times
@@ -1317,7 +1338,8 @@ class TpuPolicyEngine:
                 )
                 if nbytes <= _PRE_CACHE_MAX_BYTES:
                     self._pre_cache = (key, pre)  # evicts any other set
-                    self._slab_ops_cache = None  # stale for the new set
+                    with self._slab_lock:
+                        self._slab_ops_cache = None  # stale for new set
                     self._pre_cache_misses = 0
                     ti.PRE_CACHE_BYTES.set(nbytes)
                 else:
@@ -1342,7 +1364,8 @@ class TpuPolicyEngine:
                 self._pre_cache_misses += 1
                 if self._pre_cache_misses >= 2:
                     self._pre_cache = None
-                    self._slab_ops_cache = None  # its HBM goes with the pre
+                    with self._slab_lock:
+                        self._slab_ops_cache = None  # HBM goes with the pre
                     ti.PRE_CACHE_BYTES.set(0)
             with phase("engine.dispatch"):
                 partials = self._counts_packed_jit(
@@ -1364,15 +1387,18 @@ class TpuPolicyEngine:
         return sum_partials(partials, len(cases), n)
 
     def _steady_state_args(self, cases: Sequence[PortCase]):
-        """(key, slab_ok, slab_args, (q_port, q_name, q_proto)) for the
-        pinned-precompute steady state — THE single definition of which
-        program a steady-state dispatch runs, shared by
+        """(key, slab_ok, slab_args, (q_port, q_name, q_proto), choice)
+        for the pinned-precompute steady state — THE single definition
+        of which program a steady-state dispatch runs, shared by
         evaluate_grid_counts and counts_pipelined_eval_s so the two can
         never measure different programs.  slab_args engages only when a
         plan exists, the autotune chose it, AND the slab's materialized
         HBM bytes fit the budget at THIS case count (plan time budgets
         q=2 — a larger case list must fall back to the default kernel,
-        not OOM the device)."""
+        not OOM the device).  The slab choice is read ONCE under
+        _slab_lock and returned, so callers branch on one coherent value
+        instead of re-reading an attribute the autotune's abandoned
+        candidate thread may be racing."""
         q_port, q_name, q_proto = self._port_case_arrays(cases)
         n = self.encoding.cluster.n_pods
         key = (q_port.tobytes(), q_name.tobytes(), q_proto.tobytes(), n)
@@ -1381,12 +1407,14 @@ class TpuPolicyEngine:
             self._slab_bytes_per_case is None
             or len(cases) * self._slab_bytes_per_case <= self._slab_budget
         )
+        with self._slab_lock:
+            choice = self._slab_choice
         slab_args = (
             (slab["egress"], slab["ingress"])
-            if slab_ok and self._slab_choice is True
+            if slab_ok and choice is True
             else (None, None)
         )
-        return key, slab_ok, slab_args, (q_port, q_name, q_proto)
+        return key, slab_ok, slab_args, (q_port, q_name, q_proto), choice
 
     def _slab_ops_for(self, key):
         """Device-resident gathered slab operands for the pinned case
@@ -1396,17 +1424,33 @@ class TpuPolicyEngine:
         pinning holds the SAME bytes a per-dispatch rebuild would
         transiently allocate, trading that rebuild (measured at more
         than the depth cut's savings, r5) for residency."""
-        if (
-            self._slab_ops_cache is not None
-            and self._slab_ops_cache[0] == key
-        ):
+        # one locked read of the (key, ops) tuple: the old
+        # `self._slab_ops_cache is not None and self._slab_ops_cache[0]`
+        # double read could interleave with the autotune rejection's
+        # clear and crash on None[0] (found by tools/locklint.py LK001;
+        # the schedule is fuzzed by tests/raceharness.py)
+        with self._slab_lock:
+            cached = self._slab_ops_cache
+        if cached is not None and cached[0] == key:
             ti.SLAB_OPS_CACHE_HITS.inc()
-            return self._slab_ops_cache[1]
+            return cached[1]
         ti.SLAB_OPS_CACHE_MISSES.inc()
         slab = self._slab_plan_state
         n32 = np.int32(self.encoding.cluster.n_pods)
+        # snapshot _pre_cache ONCE: the issuing thread guarantees it is
+        # pinned before calling here, but the abandoned autotune thread
+        # has no such guarantee — the issuing thread's 2-miss eviction
+        # can null it mid-build, and a direct self._pre_cache[1] read
+        # would crash on None[1].  The raise is a contained candidate
+        # failure (run_bounded catches it and the autotune rejects).
+        pre_cache = self._pre_cache
+        if pre_cache is None:
+            raise RuntimeError(
+                "slab operand build raced pre-cache eviction "
+                "(abandoned autotune candidate; contained)"
+            )
         ops = self._slab_ops_jit(
-            self._pre_cache[1], n32, slab["egress"], slab["ingress"],
+            pre_cache[1], n32, slab["egress"], slab["ingress"],
             w=slab.get("w"),
         )
         # the ACTUAL pinned bytes supersede the plan-time q=2 estimate
@@ -1452,7 +1496,7 @@ class TpuPolicyEngine:
         queue and would pollute a number recorded as stable)."""
         import time as _time
 
-        key, _slab_ok, slab_args, _qs = self._steady_state_args(cases)
+        key, _slab_ok, slab_args, _qs, _choice = self._steady_state_args(cases)
         if self._pre_cache is None or self._pre_cache[0] != key:
             return None
         self._drain_autotune_orphan()

@@ -28,6 +28,15 @@ and stored as bf16 (ready for the MXU).  Matmul outputs use bf16
 accumulation: inputs are 0/1, so every partial sum is a sum of nonnegative
 values >= 1 at the first hit — rounding can never drive a positive count
 to zero, so the `> 0` threshold stays exact.
+
+Threading note (lock discipline, docs/DESIGN.md): everything here is
+pure functions of explicit operands — no module-level mutable state, no
+locks — by design.  All caching of these programs' operands (the pinned
+precompute, the gathered slab operands) lives in api.TpuPolicyEngine,
+where it is guarded by _slab_lock and checked by tools/locklint.py;
+keep it that way rather than adding module-level caches here (a second
+cache layer would need its own lock AND a consistent order against
+_slab_lock to stay off the LK002 cycle graph).
 """
 
 from __future__ import annotations

@@ -111,7 +111,7 @@ class LoopbackKubernetes(MockKubernetes):
         _INSTANCES[0] += 1
         self._base = 10 + (os.getpid() * 7 + _INSTANCES[0]) % 200
         self._ready_timeout_s = ready_timeout_s
-        self._servers: Dict[Tuple[str, str], subprocess.Popen] = {}
+        self._servers: Dict[Tuple[str, str], subprocess.Popen] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._tmp = tempfile.mkdtemp(prefix="cyclonus-loopback-")
         self.verdict_path = os.path.join(self._tmp, "verdicts.json")

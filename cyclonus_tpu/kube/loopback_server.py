@@ -42,8 +42,8 @@ class VerdictMap:
 
     def __init__(self, path: str):
         self.path = path
-        self._stamp = None
-        self._allow = frozenset()
+        self._stamp = None  # guarded-by: self._lock
+        self._allow = frozenset()  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def allowed(self, src_ip: str, dst_ip: str, port: int, proto: str) -> bool:

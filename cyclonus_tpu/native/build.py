@@ -17,8 +17,8 @@ _SRC = os.path.join(os.path.dirname(__file__), "fast_oracle.cpp")
 _LIB = os.path.join(os.path.dirname(__file__), "_fast_oracle.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_error: Optional[str] = None
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
+_error: Optional[str] = None  # guarded-by: _lock
 
 
 class NativeUnavailable(Exception):
@@ -42,7 +42,11 @@ def _build() -> None:
     ]
     try:
         try:
-            proc = subprocess.run(
+            # holding _lock across the build is the point: load_library
+            # is build-once memoization, and concurrent callers must
+            # WAIT for the .so rather than race a second g++; the
+            # subprocess is bounded by timeout=120
+            proc = subprocess.run(  # locklint: ignore[LK003]
                 cmd, capture_output=True, text=True, timeout=120
             )
         except subprocess.TimeoutExpired as e:

@@ -143,9 +143,14 @@ def mark() -> int:
 
 def since(marker: int) -> List[Dict[str, Any]]:
     """Events appended after `mark()` that are still in the window (the
-    worker uses this to slice out exactly its batch's events)."""
-    snap = RING.snapshot()
-    new = RING.appended - marker
+    worker uses this to slice out exactly its batch's events).  The
+    window and the append count come from ONE lock hold
+    (snapshot_with_count): with the old separate snapshot()/.appended
+    reads, appends landing between them inflated the count and the
+    slice returned PRE-marker events — another thread's spans leaked
+    into the worker's batch."""
+    snap, appended = RING.snapshot_with_count()
+    new = appended - marker
     if new <= 0:
         return []
     return snap[-min(new, len(snap)):]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import guards
 from . import state
 
 # ~2.5x log-spaced seconds buckets, 100 us .. 2 min: wide enough for a
@@ -53,20 +54,27 @@ def _label_str(items: Sequence[Tuple[str, Any]]) -> str:
     return "{" + inner + "}"
 
 
+@guards.checked
 class Metric:
     """Base: a named family with fixed label names and per-label-value
     series created on first touch."""
 
     kind = "untyped"
 
+    # runtime twin of the guarded-by contract (tools/locklint.py LK001)
+    _series = guards.Guarded("_lock")
+
     def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
-        self._series: Dict[Tuple[Any, ...], Any] = {}
-        if not self.labelnames:
-            self._series[()] = self._zero()
+        self._lock = guards.lock()
+        # unlabeled families carry a 0-valued sample from birth; one
+        # assignment so construction stays a single (pre-publication)
+        # write of the guarded attribute
+        self._series: Dict[Tuple[Any, ...], Any] = (  # guarded-by: self._lock
+            {(): self._zero()} if not self.labelnames else {}
+        )
 
     def _zero(self) -> Any:
         return 0.0
@@ -229,13 +237,17 @@ class Histogram(Metric):
         }
 
 
+@guards.checked
 class MetricRegistry:
     """Name -> metric family; creation is idempotent (same name + kind
     returns the existing family, so import order never matters)."""
 
+    # runtime twin of the guarded-by contract (tools/locklint.py LK001)
+    _metrics = guards.Guarded("_lock")
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._metrics: Dict[str, Metric] = {}
+        self._lock = guards.lock()
+        self._metrics: Dict[str, Metric] = {}  # guarded-by: self._lock
 
     def _register(self, cls, name: str, help: str, labelnames, **kw) -> Metric:
         with self._lock:
@@ -297,10 +309,13 @@ class MetricRegistry:
         return {name: metric.snapshot() for name, metric in families}
 
     def reset(self) -> None:
-        """Zero every series (keeps registrations; tests and bench)."""
+        """Zero every series (keeps registrations; tests and bench).
+        Lock order: registry before metric — the only nested
+        acquisition in the package; Metric methods never take the
+        registry lock, so the LK002 graph stays acyclic."""
         with self._lock:
             for m in self._metrics.values():
-                with m._lock:
+                with m._lock:  # locklint: lock-class Metric
                     m._series.clear()
                     if not m.labelnames:
                         m._series[()] = m._zero()

@@ -39,8 +39,8 @@ def _default_capacity() -> int:
 RING = BoundedRing(_default_capacity())
 
 _lock = threading.Lock()
-_seq = {"n": 0}
-_hook = {"installed": False, "previous": None}
+_seq = {"n": 0}  # guarded-by: _lock
+_hook = {"installed": False, "previous": None}  # guarded-by: _lock
 
 
 def record(**entry: Any) -> None:
@@ -102,12 +102,19 @@ def _crash_hook(exc_type, exc, tb) -> None:
             dump(reason=f"crash: {exc_type.__name__}: {exc}")
     except Exception:
         pass  # the dump must never mask the crash itself
-    prev = _hook["previous"] or sys.__excepthook__
+    # lock-FREE read, deliberately: the excepthook may run while some
+    # wedged thread holds _lock (the very state worth crash-reporting),
+    # and blocking here would hang the process silently instead of
+    # printing the traceback.  'previous' is written once, under the
+    # lock, before this hook can ever fire — the race is benign.
+    prev = _hook["previous"] or sys.__excepthook__  # locklint: ignore[LK001]
     prev(exc_type, exc, tb)
 
 
 def _install_crash_hook() -> None:
-    if _hook["installed"]:
+    # double-checked fast path: a stale False only costs the lock below,
+    # and the locked re-check makes the install itself race-free
+    if _hook["installed"]:  # locklint: ignore[LK001]
         return
     with _lock:
         if _hook["installed"]:

@@ -106,7 +106,11 @@ class _Handler(BaseHTTPRequestHandler):
             out_dir = tempfile.mkdtemp(prefix="cyclonus-profile-")
             t0 = time.time()
             with jax_profile(out_dir):
-                time.sleep(seconds)
+                # _PROFILE_LOCK exists to serialize captures, and the
+                # sleep IS the capture window; other endpoints stay
+                # responsive (ThreadingHTTPServer), concurrent /profile
+                # requests get the 409 above instead of queueing here
+                time.sleep(seconds)  # locklint: ignore[LK003]
             self._send_json(
                 {
                     "artifact": out_dir,
@@ -158,28 +162,40 @@ class MetricsServer:
         self._thread.join(timeout=5)
 
 
-_ACTIVE: dict = {"server": None}
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: dict = {"server": None}  # guarded-by: _ACTIVE_LOCK
 
 
 def start_metrics_server(port: int, host: str = "127.0.0.1") -> MetricsServer:
     """Start (or return the already-running) metrics server.  One per
     process: a second call with a different port replaces nothing — the
     live server wins, matching the process-global registry it serves.
-    Raises MetricsPortBusy (one clean line) when the port is taken."""
-    srv = _ACTIVE["server"]
-    if srv is not None:
+    Raises MetricsPortBusy (one clean line) when the port is taken.
+
+    The whole check-bind-store runs under _ACTIVE_LOCK: the unguarded
+    version let two racing callers both see None and each bind a server
+    (the loser's socket + daemon thread leaked for the process's life,
+    and with port 0 the two callers curl'd different ports)."""
+    with _ACTIVE_LOCK:
+        srv = _ACTIVE["server"]
+        if srv is not None:
+            return srv
+        srv = MetricsServer(port, host)
+        _ACTIVE["server"] = srv
         return srv
-    srv = MetricsServer(port, host)
-    _ACTIVE["server"] = srv
-    return srv
 
 
 def active_server() -> Optional[MetricsServer]:
-    return _ACTIVE["server"]
+    with _ACTIVE_LOCK:
+        return _ACTIVE["server"]
 
 
 def stop_metrics_server() -> None:
-    srv = _ACTIVE["server"]
-    if srv is not None:
+    # unregister under the lock, CLOSE outside it: close() joins the
+    # serve_forever thread (up to 5s), and a concurrent scrape or a
+    # fresh start_metrics_server must not stall behind that join
+    with _ACTIVE_LOCK:
+        srv = _ACTIVE["server"]
         _ACTIVE["server"] = None
+    if srv is not None:
         srv.close()

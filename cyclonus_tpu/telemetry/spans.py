@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from ..utils import guards
 from . import events, state
 
 logger = logging.getLogger("cyclonus.trace")
@@ -69,13 +70,18 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+@guards.checked
 class SpanRegistry:
     """Thread-safe per-process aggregation of completed spans."""
 
+    # runtime twins of the guarded-by contract (tools/locklint.py LK001)
+    _flat = guards.Guarded("_lock")
+    _tree = guards.Guarded("_lock")
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._flat: Dict[str, Dict[str, float]] = {}
-        self._tree: Dict[str, Dict[str, Any]] = {}
+        self._lock = guards.lock()
+        self._flat: Dict[str, Dict[str, float]] = {}  # guarded-by: self._lock
+        self._tree: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
 
     def record(
         self, path: str, name: str, dt: float, attrs: Dict[str, Any]

@@ -16,20 +16,28 @@ import threading
 from collections import deque
 from typing import Any, Callable, List, Tuple
 
+from . import guards
 
+
+@guards.checked
 class BoundedRing:
     """Thread-safe fixed-capacity append-only ring: the newest `maxlen`
     items win.  The storage primitive of the telemetry flight recorder
     (telemetry/recorder.py) — bounded by construction so a process that
     evaluates forever holds a constant-size history."""
 
+    # runtime twins of the guarded-by contract (tools/locklint.py LK001;
+    # active only under CYCLONUS_GUARD_CHECK=1, plain attrs otherwise)
+    _items = guards.Guarded("_lock")
+    _appended = guards.Guarded("_lock")
+
     def __init__(self, maxlen: int):
         if maxlen <= 0:
             raise ValueError(f"BoundedRing maxlen must be positive, got {maxlen}")
         self.maxlen = maxlen
-        self._lock = threading.Lock()
-        self._items: deque = deque(maxlen=maxlen)
-        self._appended = 0  # lifetime total, survives wrap-around
+        self._lock = guards.lock()
+        self._items: deque = deque(maxlen=maxlen)  # guarded-by: self._lock
+        self._appended = 0  # guarded-by: self._lock (lifetime total)
 
     def append(self, item: Any) -> None:
         with self._lock:
@@ -40,6 +48,16 @@ class BoundedRing:
         """Oldest-to-newest copy of the current window."""
         with self._lock:
             return list(self._items)
+
+    def snapshot_with_count(self) -> Tuple[List[Any], int]:
+        """(oldest-to-newest copy, lifetime append count) from ONE lock
+        hold.  Callers doing what's-new-since-marker math
+        (telemetry/events.since) need both from the same instant: a
+        snapshot() call followed by a separate .appended read admits
+        appends in between, and the inflated count makes pre-marker
+        items look new."""
+        with self._lock:
+            return list(self._items), self._appended
 
     def clear(self) -> None:
         with self._lock:

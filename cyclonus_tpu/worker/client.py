@@ -11,6 +11,13 @@ from .model import Batch, Result
 
 
 class Client:
+    """Stateless per-call by design (lock discipline, docs/DESIGN.md):
+    probe runners issue batches from a thread pool, so the client holds
+    no mutable state of its own — the only shared structure the batch
+    path touches is the trace-event ring, whose BoundedRing lock (and
+    pid-dedup in events.ingest) makes concurrent ingestion safe.
+    tests/raceharness.py `worker_ingest` fuzzes exactly this path."""
+
     def __init__(self, kubernetes: IKubernetes):
         self.kubernetes = kubernetes
 

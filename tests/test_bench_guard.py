@@ -170,6 +170,12 @@ class TestBenchGuards:
         tel = detail["telemetry"]
         assert "cyclonus_tpu_pre_cache_hits_total" in tel["metrics"]
         assert "cyclonus_tpu_slab_hbm_bytes" in tel["metrics"]
+        # the lock-discipline annotations (guarded _slab_choice /
+        # _slab_ops_cache, locked reads in the dispatch path) must not
+        # cost the telemetry block its cache-counter schema — the
+        # counters live on exactly the code paths that were annotated
+        assert "cyclonus_tpu_slab_ops_cache_hits_total" in tel["metrics"]
+        assert "cyclonus_tpu_slab_ops_cache_misses_total" in tel["metrics"]
         assert "engine.dispatch" in tel["phases"]
         assert any(
             e["path"].startswith("counts.") for e in tel["flight_recorder"]

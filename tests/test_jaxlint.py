@@ -263,3 +263,113 @@ class TestCleanRun:
             [sys.executable, tool, ENGINE], capture_output=True, text=True
         )
         assert r2.returncode == 0, r2.stdout
+
+
+class TestJX005HostCallbacks:
+    """Host callbacks staged into jit code force a device->host round
+    trip per execution: every spelling in use must be caught, and host
+    code (outside jit) must stay exempt."""
+
+    def test_jax_debug_print(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def kernel(x):
+                jax.debug.print("x = {}", x)
+                return x + 1
+            """,
+        )
+        assert _codes(findings) == ["JX005"]
+        assert "jax.debug.print" in findings[0].message
+
+    def test_jax_debug_callback(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def kernel(x):
+                jax.debug.callback(lambda v: None, x)
+                return x
+            """,
+        )
+        assert _codes(findings) == ["JX005"]
+
+    def test_pure_callback_attr_and_alias(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            from jax import pure_callback
+
+            @jax.jit
+            def a(x):
+                return jax.pure_callback(abs, x, x)
+
+            @jax.jit
+            def b(x):
+                return pure_callback(abs, x, x)
+            """,
+        )
+        assert _codes(findings) == ["JX005", "JX005"]
+
+    def test_io_callback_from_experimental(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            from jax.experimental import io_callback
+
+            @jax.jit
+            def kernel(x):
+                io_callback(print, None, x)
+                return x
+            """,
+        )
+        assert _codes(findings) == ["JX005"]
+
+    def test_host_callback_module(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            from jax.experimental import host_callback as hcb
+
+            @jax.jit
+            def kernel(x):
+                hcb.id_print(x)
+                return x
+            """,
+        )
+        assert _codes(findings) == ["JX005"]
+
+    def test_debug_print_outside_jit_ok(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            def host_side(x):
+                jax.debug.print("x = {}", x)
+                return x
+            """,
+        )
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def kernel(x):
+                jax.debug.print("x = {}", x)  # jaxlint: ignore[JX005]
+                return x + 1
+            """,
+        )
+        assert findings == []
+
+    def test_extended_packages_clean(self):
+        """make lint coverage now includes analysis/ and probe/: both
+        must be finding-free (any justified exception would carry a
+        `# jaxlint: ignore` with its reason)."""
+        for pkg in ("analysis", "probe", "telemetry", "worker"):
+            pkg_dir = os.path.join(REPO, "cyclonus_tpu", pkg)
+            findings = []
+            for f in jaxlint.iter_py_files([pkg_dir]):
+                findings.extend(jaxlint.lint_file(f))
+            assert findings == [], "\n".join(x.render() for x in findings)

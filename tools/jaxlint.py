@@ -21,6 +21,11 @@ flags, via a per-function taint pass seeded from the traced parameters:
          module-level array — the array is baked into the compiled
          program as a constant; rebinding the global silently keeps the
          stale weights
+  JX005  host callback inside a jit-traced function: jax.debug.print /
+         jax.debug.callback / pure_callback / io_callback /
+         host_callback — each staged call round-trips device->host
+         EVERY step, serializing the dispatch pipeline (fine for a
+         debug session, never for a hot path)
 
 `static_argnames` / `static_argnums` parameters are exempt from taint
 (branching on a static is the whole point of statics), as are shape /
@@ -49,6 +54,10 @@ from typing import Dict, List, Optional, Set, Tuple
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
 COERCIONS = {"float", "int", "bool", "complex"}
 SYNC_METHODS = {"item", "tolist"}
+# names that stage a host callback into the compiled program (JX005);
+# matched as jax.* attribute chains and as from-imported aliases
+HOST_CALLBACKS = {"pure_callback", "io_callback"}
+HOST_CALLBACK_MODULES = ("jax.experimental.host_callback",)
 EXEMPT_CALLS = {"isinstance", "len", "hasattr", "callable", "getattr", "type"}
 MUTABLE_DEFAULTS = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)
 _IGNORE_RE = re.compile(r"#\s*jaxlint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
@@ -432,8 +441,52 @@ class TaintChecker:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             self._check_defaults(stmt)
 
+    def _host_callback_name(self, f: ast.AST) -> Optional[str]:
+        """Dotted name when `f` denotes a host-callback staging function
+        (jax.debug.print / jax.debug.callback / pure_callback /
+        io_callback / host_callback.*), else None."""
+        if isinstance(f, ast.Attribute):
+            chain: List[str] = []
+            node: ast.AST = f
+            while isinstance(node, ast.Attribute):
+                chain.append(node.attr)
+                node = node.value
+            if not isinstance(node, ast.Name):
+                return None
+            chain.reverse()
+            path = self.info.aliases.get(node.id, node.id)
+            if not (path == "jax" or path.startswith("jax.")):
+                return None
+            full = ".".join([path] + chain)
+            if full.startswith(HOST_CALLBACK_MODULES):
+                return full
+            if chain[-1] in HOST_CALLBACKS:
+                return full
+            if "debug" in full.split(".") and chain[-1] in ("print", "callback"):
+                return full
+            return None
+        if isinstance(f, ast.Name):
+            path = self.info.aliases.get(f.id, "")
+            if path.startswith("jax") and path.split(".")[-1] in HOST_CALLBACKS:
+                return path
+            if path.startswith(HOST_CALLBACK_MODULES):
+                return path
+            if path in ("jax.debug.print", "jax.debug.callback"):
+                return path
+        return None
+
     def _check_call(self, node: ast.Call) -> None:
         f = node.func
+        cb = self._host_callback_name(f)
+        if cb is not None:
+            self._add(
+                node,
+                "JX005",
+                f"host callback {cb}() staged into a jit-traced function "
+                f"(device->host round trip on every execution; gate it "
+                f"behind a debug flag or move it to host code)",
+            )
+            return
         if (
             isinstance(f, ast.Attribute)
             and f.attr in SYNC_METHODS

@@ -1,0 +1,880 @@
+#!/usr/bin/env python
+"""Lock-discipline static lint: AST checks for the concurrency defect
+classes the threaded telemetry/worker/engine paths keep meeting (the
+_slab_lock TOCTOU of PR 1 was found by hand; this pass finds its family
+mechanically, in the spirit of Clang's Thread Safety Analysis and of the
+reference project's `go test -race` gate).
+
+  LK001  guarded-by: an attribute declared `# guarded-by: <lock-expr>`
+         (trailing comment on its initializing assignment), via a
+         class-level `GUARDED_BY = {"attr": "self.<lock>"}` map, or via
+         a `guards.Guarded("<lock>")` descriptor, is read or written
+         outside a `with <lock-expr>:` block.  Constructors are exempt
+         (construction happens-before publication).  A function whose
+         docstring contains `holds-lock: <lock-expr>` or that is
+         decorated `@guards.holds("<lock-expr>")` is analyzed with the
+         lock held; a private helper whose visible call sites ALL hold
+         the lock inherits it one level, like jaxlint's nested-def
+         taint.
+
+  LK002  lock-order cycle: the whole-run acquisition graph (nested
+         `with` statements, plus calls one level deep into same-module
+         functions that acquire) contains a cycle — the classic
+         deadlock precondition.  The finding message carries the cycle
+         path.  A self-edge (re-acquiring a non-reentrant Lock) is a
+         one-node cycle.
+
+  LK003  leaked guard: `<lock>.acquire()` with no matching `release()`
+         inside a `finally` block in the same function; or a blocking
+         call (time.sleep / subprocess / socket / requests / urlopen /
+         kubectl exec / Thread.join / Event.wait) made while a declared
+         lock is held — the whole process stalls behind one slow
+         syscall.
+
+Lock discovery: module-level `NAME = threading.Lock()` / `RLock()`, and
+`self.NAME = threading.Lock()` inside a class, plus anything named by a
+guarded-by declaration.  Lock identity for the cycle graph is
+`<module>.<Class>.<attr>` / `<module>.<name>`, so two classes' private
+`_lock`s never alias.
+
+Suppress a finding with `# locklint: ignore` or
+`# locklint: ignore[LK001,...]` on the offending line (same convention
+as tools/jaxlint.py).
+
+Usage: python tools/locklint.py [paths...]   (default: cyclonus_tpu)
+Exit status 1 iff findings remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_IGNORE_RE = re.compile(r"#\s*locklint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_HOLDS_DOC_RE = re.compile(r"holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+# `with m._lock:  # locklint: lock-class Metric` — declares the class
+# owning a NON-self lock expression, so the acquisition enters the
+# LK002 graph under that class's lock identity (static receiver typing
+# is out of scope; the declaration is the Clang-TSA-style answer)
+_LOCK_CLASS_RE = re.compile(r"#\s*locklint:\s*lock-class\s+([A-Za-z_][A-Za-z0-9_]*)")
+
+# Call roots / attribute names that block the calling thread.  Holding a
+# declared lock across any of these serializes every hot-path thread
+# behind one syscall (and, for Event.wait/Thread.join, risks deadlock
+# when the waited-on thread needs the same lock).
+BLOCKING_ROOTS = {"subprocess", "socket", "requests", "urllib"}
+BLOCKING_ATTRS = {
+    "sleep",                    # time.sleep
+    "execute_remote_command",   # kubectl exec (kube/ikubernetes.py)
+    "check_output", "check_call", "communicate", "urlopen",
+    "wait", "join",             # Event.wait / Thread.join
+}
+CONSTRUCTOR_EXEMPT = {"__init__", "__new__", "__set_name__", "__init_subclass__"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+def _expr_str(node: ast.AST) -> str:
+    """Normalized source text of a lock expression ('self._lock')."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse handles all exprs we meet
+        return ""
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """threading.Lock() / threading.RLock() / Lock() / RLock() /
+    guards.lock() (the ownership-checkable ctor of utils/guards.py)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("Lock", "RLock"):
+        return _attr_root(f) == "threading"
+    if isinstance(f, ast.Attribute) and f.attr == "lock":
+        return _attr_root(f) == "guards"
+    if isinstance(f, ast.Name) and f.id in ("Lock", "RLock"):
+        return True
+    return False
+
+
+def _is_guarded_ctor(node: ast.AST) -> Optional[str]:
+    """`guards.Guarded("_lock")` / `Guarded("_lock")` -> 'self._lock'."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name != "Guarded":
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return f"self.{arg.value}"
+    return None
+
+
+@dataclass
+class ClassModel:
+    name: str
+    # attr name -> guarding lock expression ("self._lock")
+    guarded: Dict[str, str] = field(default_factory=dict)
+    locks: Set[str] = field(default_factory=set)  # {"self._lock", ...}
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    bases: List[str] = field(default_factory=list)  # same-module names
+
+
+@dataclass
+class ModuleModel:
+    path: str
+    modname: str
+    lines: List[str]
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    # module-level guarded name -> lock expression ("_lock")
+    guarded_globals: Dict[str, str] = field(default_factory=dict)
+    module_locks: Set[str] = field(default_factory=set)
+    functions: Dict[str, ast.AST] = field(default_factory=dict)
+
+
+# one acquisition-order edge: lock A held while lock B is acquired
+@dataclass(frozen=True)
+class Edge:
+    src: str  # global lock id
+    dst: str
+    path: str
+    line: int
+    col: int
+
+
+def effective_class_view(
+    model: "ModuleModel", cls: Optional["ClassModel"]
+) -> Tuple[Dict[str, str], Set[str]]:
+    """(guarded map, lock set) merged through same-module base classes,
+    subclass declarations winning — Counter.inc mutates Metric's guarded
+    `_series`, and the contract must follow the inheritance, not the
+    syntactic class."""
+    guarded: Dict[str, str] = {}
+    locks: Set[str] = set()
+    seen: Set[str] = set()
+
+    def visit(c: Optional["ClassModel"]) -> None:
+        if c is None or c.name in seen:
+            return
+        seen.add(c.name)
+        for b in c.bases:
+            visit(model.classes.get(b))
+        guarded.update(c.guarded)
+        locks.update(c.locks)
+
+    visit(cls)
+    return guarded, locks
+
+
+def declaring_class(
+    model: "ModuleModel", cls: Optional["ClassModel"], expr: str
+) -> Optional[str]:
+    """Base-most same-module class whose own body declares lock `expr`
+    ('self._lock') — lock IDENTITY follows the declaration, so a
+    subclass's `with self._lock:` aliases its base's lock in the LK002
+    graph (it IS the same object at runtime)."""
+    best: List[str] = []
+    seen: Set[str] = set()
+
+    def visit(c: Optional["ClassModel"]) -> None:
+        if c is None or c.name in seen:
+            return
+        seen.add(c.name)
+        for b in c.bases:
+            visit(model.classes.get(b))
+        if not best and expr in c.locks:
+            best.append(c.name)
+
+    visit(cls)
+    if best:
+        return best[0]
+    return cls.name if cls is not None else None
+
+
+def _module_name(path: str) -> str:
+    rel = os.path.relpath(path).replace(os.sep, "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def _trailing_guard(lines: List[str], lineno: int) -> Optional[str]:
+    if 0 < lineno <= len(lines):
+        m = _GUARDED_BY_RE.search(lines[lineno - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+def build_model(path: str, tree: ast.Module, lines: List[str]) -> ModuleModel:
+    model = ModuleModel(path=path, modname=_module_name(path), lines=lines)
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.functions[stmt.name] = stmt
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if stmt.value is not None and _is_lock_ctor(stmt.value):
+                    model.module_locks.add(t.id)
+                guard = _trailing_guard(lines, stmt.lineno)
+                if guard:
+                    model.guarded_globals[t.id] = guard
+                    model.module_locks.add(guard.split(".")[-1])
+        elif isinstance(stmt, ast.ClassDef):
+            cm = ClassModel(name=stmt.name)
+            cm.bases = [
+                b.id if isinstance(b, ast.Name) else b.attr
+                for b in stmt.bases
+                if isinstance(b, (ast.Name, ast.Attribute))
+            ]
+            model.classes[stmt.name] = cm
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cm.methods[sub.name] = sub
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        lock = _is_guarded_ctor(sub.value)
+                        if lock:
+                            cm.guarded[t.id] = lock
+                            cm.locks.add(lock)
+                        elif t.id == "GUARDED_BY" and isinstance(
+                            sub.value, ast.Dict
+                        ):
+                            for k, v in zip(sub.value.keys, sub.value.values):
+                                if (
+                                    isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)
+                                    and isinstance(v, ast.Constant)
+                                    and isinstance(v.value, str)
+                                ):
+                                    cm.guarded[k.value] = v.value
+                                    cm.locks.add(v.value)
+            # self.X = threading.Lock() / guarded-by trailing comments,
+            # anywhere inside the class's methods
+            for meth in cm.methods.values():
+                for node in ast.walk(meth):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if not (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            continue
+                        if node.value is not None and _is_lock_ctor(node.value):
+                            cm.locks.add(f"self.{t.attr}")
+                        guard = _trailing_guard(lines, node.lineno)
+                        if guard:
+                            cm.guarded[t.attr] = guard
+                            cm.locks.add(guard)
+    return model
+
+
+def _declared_holds(func: ast.AST) -> Set[str]:
+    """Locks a function declares held: docstring `holds-lock: expr`
+    lines and `@guards.holds("expr")` decorators."""
+    out: Set[str] = set()
+    doc = ast.get_docstring(func, clean=False) or ""
+    out.update(_HOLDS_DOC_RE.findall(doc))
+    for dec in getattr(func, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            name = (
+                dec.func.attr
+                if isinstance(dec.func, ast.Attribute)
+                else dec.func.id if isinstance(dec.func, ast.Name) else None
+            )
+            if name == "holds":
+                for a in dec.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        out.add(a.value)
+    return out
+
+
+def _with_locks(stmt: ast.With, known: Set[str]) -> List[str]:
+    """Lock expressions acquired by this with-statement (only exprs
+    recognized as locks in this module/class)."""
+    out = []
+    for item in stmt.items:
+        expr = _expr_str(item.context_expr)
+        if expr in known:
+            out.append(expr)
+    return out
+
+
+class FunctionChecker:
+    """LK001 + LK003 over ONE function, and acquisition-edge collection
+    for the global LK002 graph."""
+
+    def __init__(
+        self,
+        model: ModuleModel,
+        cls: Optional[ClassModel],
+        func: ast.AST,
+        entry_locks: Set[str],
+    ):
+        self.model = model
+        self.cls = cls
+        self.func = func
+        self.entry = set(entry_locks) | _declared_holds(func)
+        self.findings: List[Finding] = []
+        self.edges: List[Edge] = []
+        # guarded contract + lock set, merged through base classes
+        self.guarded_map, cls_locks = effective_class_view(model, cls)
+        # every lock expr this function might name
+        self.known: Set[str] = set(model.module_locks) | cls_locks
+        self.known |= set(model.guarded_globals.values())
+        # non-self lock exprs declared via `# locklint: lock-class C`,
+        # mapped to their owning class's lock id for the LK002 graph
+        self.foreign: Dict[str, str] = {}
+        # released-in-finally set for LK003a
+        self._finally_released: Set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Try):
+                for s in node.finalbody:
+                    for call in ast.walk(s):
+                        if (
+                            isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Attribute)
+                            and call.func.attr == "release"
+                        ):
+                            self._finally_released.add(
+                                _expr_str(call.func.value)
+                            )
+
+    # -- lock identity -----------------------------------------------------
+
+    def lock_id(self, expr: str) -> str:
+        if expr in self.foreign:
+            return self.foreign[expr]
+        if expr.startswith("self.") and self.cls is not None:
+            owner = declaring_class(self.model, self.cls, expr)
+            return f"{self.model.modname}.{owner}.{expr[5:]}"
+        return f"{self.model.modname}.{expr}"
+
+    def _with_locks_here(self, stmt: ast.With) -> List[str]:
+        """Lock exprs this with-statement acquires: recognized self./
+        module locks, plus non-self `<obj>.<attr>` exprs the line
+        declares via `# locklint: lock-class <Class>` (registered under
+        that class's lock identity)."""
+        out = _with_locks(stmt, self.known)
+        line = (
+            self.model.lines[stmt.lineno - 1]
+            if 0 < stmt.lineno <= len(self.model.lines)
+            else ""
+        )
+        m = _LOCK_CLASS_RE.search(line)
+        if m:
+            for item in stmt.items:
+                expr = _expr_str(item.context_expr)
+                if expr in out or not isinstance(
+                    item.context_expr, ast.Attribute
+                ):
+                    continue
+                self.foreign[expr] = (
+                    f"{self.model.modname}.{m.group(1)}."
+                    f"{item.context_expr.attr}"
+                )
+                self.known.add(expr)
+                out.append(expr)
+        return out
+
+    # -- traversal ---------------------------------------------------------
+
+    def run(self) -> None:
+        held = set(self.entry)
+        for stmt in self.func.body:
+            self._visit(stmt, held)
+
+    def _visit(self, stmt: ast.AST, held: Set[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs get their own checker via the module pass;
+            # their bodies run at call time, not under these locks
+            return
+        if isinstance(stmt, ast.With):
+            self._check_exprs(stmt, held)
+            # `with A, B:` acquires in order: A is held when B is taken
+            inner = set(held)
+            for lock in self._with_locks_here(stmt):
+                for heldlock in inner:
+                    self.edges.append(
+                        Edge(
+                            self.lock_id(heldlock),
+                            self.lock_id(lock),
+                            self.model.path,
+                            stmt.lineno,
+                            stmt.col_offset,
+                        )
+                    )
+                inner.add(lock)
+            for s in stmt.body:
+                self._visit(s, inner)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_node(stmt.test, held)
+            # `if not lock.acquire(blocking=False): return` — the TEST
+            # runs on every path, so its acquire is held from here to
+            # function exit (conservative).  Acquires INSIDE a branch
+            # stay scoped to that branch: a shared set would leak an
+            # if-body acquire into the else arm and the statements
+            # after, silently suppressing LK001 there.
+            held |= self._acquired_locks(stmt.test)
+            body_held = set(held)
+            for s in stmt.body:
+                self._visit(s, body_held)
+            else_held = set(held)
+            for s in stmt.orelse:
+                self._visit(s, else_held)
+            return
+        if isinstance(stmt, ast.For):
+            self._check_node(stmt.iter, held)
+            self._check_node(stmt.target, held)
+            body_held = set(held)
+            for s in stmt.body:
+                self._visit(s, body_held)
+            else_held = set(held)
+            for s in stmt.orelse:
+                self._visit(s, else_held)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self._visit(s, held)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._visit(s, held)
+            return
+        # acquire() as (part of) a statement: manual guard — LK003a and
+        # held-tracking for the rest of the function body
+        self._check_node(stmt, held)
+        acq = self._acquired_locks(stmt)
+        if acq:
+            held |= acq  # held until function exit (conservative)
+
+    def _acquired_locks(self, stmt: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                expr = _expr_str(node.func.value)
+                if expr in self.known:
+                    out.add(expr)
+                    if expr not in self._finally_released:
+                        self._add(
+                            node,
+                            "LK003",
+                            f"{expr}.acquire() without a matching "
+                            f"release() in a finally block (a raise "
+                            f"between them leaks the lock forever)",
+                        )
+        return out
+
+    # -- node-level checks -------------------------------------------------
+
+    def _check_exprs(self, stmt: ast.With, held: Set[str]) -> None:
+        for item in stmt.items:
+            self._check_node(item.context_expr, held)
+
+    def _check_node(self, node: ast.AST, held: Set[str]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                self._check_attr(sub, held)
+            elif isinstance(sub, ast.Name):
+                self._check_global(sub, held)
+            if isinstance(sub, ast.Call):
+                self._check_blocking(sub, held)
+                self._collect_call_edges(sub, held)
+
+    def _check_attr(self, node: ast.Attribute, held: Set[str]) -> None:
+        if self.cls is None:
+            return
+        if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+            return
+        lock = self.guarded_map.get(node.attr)
+        if lock is None or lock in held:
+            return
+        fname = getattr(self.func, "name", "<lambda>")
+        if fname in CONSTRUCTOR_EXEMPT:
+            return
+        verb = "written" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+        self._add(
+            node,
+            "LK001",
+            f"self.{node.attr} {verb} without declared guard "
+            f"`with {lock}:` ({self.cls.name} guarded-by contract)",
+        )
+
+    def _check_global(self, node: ast.Name, held: Set[str]) -> None:
+        lock = self.model.guarded_globals.get(node.id)
+        if lock is None or lock in held:
+            return
+        verb = "written" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+        self._add(
+            node,
+            "LK001",
+            f"module global {node.id} {verb} without declared guard "
+            f"`with {lock}:`",
+        )
+
+    def _check_blocking(self, node: ast.Call, held: Set[str]) -> None:
+        if not held:
+            return
+        f = node.func
+        blocking = None
+        if isinstance(f, ast.Attribute):
+            root = _attr_root(f)
+            if f.attr in BLOCKING_ATTRS:
+                blocking = f.attr
+            elif root in BLOCKING_ROOTS:
+                blocking = f"{root}.{f.attr}"
+        elif isinstance(f, ast.Name) and f.id in BLOCKING_ATTRS:
+            blocking = f.id
+        if blocking:
+            locks = ", ".join(sorted(held))
+            self._add(
+                node,
+                "LK003",
+                f"blocking call {blocking}() while holding {locks} "
+                f"(every thread contending on the lock stalls behind it)",
+            )
+
+    def _collect_call_edges(self, node: ast.Call, held: Set[str]) -> None:
+        """One-level interprocedural edges: while holding L, a call to a
+        same-module/class function whose body acquires K adds L->K."""
+        if not held:
+            return
+        f = node.func
+        callee: Optional[ast.AST] = None
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and self.cls is not None
+        ):
+            callee = self.cls.methods.get(f.attr)
+        elif isinstance(f, ast.Name):
+            callee = self.model.functions.get(f.id)
+        if callee is None:
+            return
+        for sub in ast.walk(callee):
+            if isinstance(sub, ast.With):
+                for lock in _with_locks(sub, self.known):
+                    for heldlock in held:
+                        self.edges.append(
+                            Edge(
+                                self.lock_id(heldlock),
+                                self.lock_id(lock),
+                                self.model.path,
+                                node.lineno,
+                                node.col_offset,
+                            )
+                        )
+
+    def _add(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(
+            Finding(
+                self.model.path, node.lineno, node.col_offset, code, message
+            )
+        )
+
+
+def _call_site_locks(
+    model: ModuleModel, cls: Optional[ClassModel], fname: str
+) -> Optional[Set[str]]:
+    """Locks held at EVERY visible call site of `fname` (one level of
+    the jaxlint-style call-site inference: a private helper only ever
+    called under the lock is analyzed as lock-held).  None when the
+    function has no visible call sites."""
+    sites: List[Set[str]] = []
+    funcs = (
+        list(cls.methods.values()) if cls is not None else []
+    ) + list(model.functions.values())
+    known: Set[str] = set(model.module_locks) | set(
+        model.guarded_globals.values()
+    )
+    _guarded, cls_locks = effective_class_view(model, cls)
+    known |= cls_locks
+
+    def find_calls(node: ast.AST, held: Set[str]) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            called = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                else f.id if isinstance(f, ast.Name) else None
+            )
+            if called == fname:
+                sites.append(set(held))
+
+    def scan(stmt: ast.AST, held: Set[str]) -> None:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                find_calls(item.context_expr, held)
+            inner = held | set(_with_locks(stmt, known))
+            for s in stmt.body:
+                scan(s, inner)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            find_calls(stmt.test, held)
+            for s in stmt.body + stmt.orelse:
+                scan(s, held)
+            return
+        if isinstance(stmt, ast.For):
+            find_calls(stmt.iter, held)
+            for s in stmt.body + stmt.orelse:
+                scan(s, held)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                scan(s, held)
+            for h in stmt.handlers:
+                for s in h.body:
+                    scan(s, held)
+            return
+        find_calls(stmt, held)
+
+    for fn in funcs:
+        if getattr(fn, "name", None) == fname:
+            continue
+        for stmt in fn.body:
+            scan(stmt, set())
+    if not sites:
+        return None
+    common = sites[0]
+    for s in sites[1:]:
+        common &= s
+    return common
+
+
+def _detect_cycles(edges: List[Edge]) -> List[Finding]:
+    """DFS over the global acquisition digraph; one finding per distinct
+    cycle (canonicalized by rotation)."""
+    graph: Dict[str, List[Edge]] = {}
+    for e in edges:
+        graph.setdefault(e.src, []).append(e)
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    for start in sorted(graph):
+        path: List[str] = []
+        path_edges: List[Edge] = []
+
+        def dfs(node: str) -> None:
+            if node in path:
+                i = path.index(node)
+                cycle = path[i:] + [node]
+                canon = tuple(sorted(cycle[:-1]))
+                if canon in seen_cycles:
+                    return
+                seen_cycles.add(canon)
+                site = path_edges[-1]
+                findings.append(
+                    Finding(
+                        site.path,
+                        site.line,
+                        site.col,
+                        "LK002",
+                        "lock-order cycle (deadlock precondition): "
+                        + " -> ".join(cycle),
+                    )
+                )
+                return
+            if len(path) > 16:  # graphs here are tiny; belt and braces
+                return
+            path.append(node)
+            for e in graph.get(node, []):
+                path_edges.append(e)
+                dfs(e.dst)
+                path_edges.pop()
+            path.pop()
+
+        dfs(start)
+    return findings
+
+
+def analyze_file(path: str) -> Tuple[List[Finding], List[Edge], int]:
+    """Per-file pass: (LK001+LK003 findings, acquisition edges, number
+    of live guarded-by declarations)."""
+    with open(path, "r") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return (
+            [Finding(path, e.lineno or 0, 0, "LK000", f"syntax error: {e.msg}")],
+            [],
+            0,
+        )
+    lines = source.splitlines()
+    model = build_model(path, tree, lines)
+    findings: List[Finding] = []
+    edges: List[Edge] = []
+
+    def check(func: ast.AST, cls: Optional[ClassModel]) -> None:
+        entry: Set[str] = set()
+        name = getattr(func, "name", "")
+        if name.startswith("_") and name not in CONSTRUCTOR_EXEMPT:
+            inherited = _call_site_locks(model, cls, name)
+            if inherited:
+                entry |= inherited
+        checker = FunctionChecker(model, cls, func, entry)
+        checker.run()
+        findings.extend(checker.findings)
+        edges.extend(checker.edges)
+
+    def check_tree(func: ast.AST, cls: Optional[ClassModel]) -> None:
+        check(func, cls)
+        # nested defs (any depth) each get their own pass in the same
+        # class context; their bodies run at call time, not under the
+        # parent's lexical locks
+        for sub in ast.walk(func):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not func
+            ):
+                check(sub, cls)
+
+    for fn in model.functions.values():
+        check_tree(fn, None)
+    for cm in model.classes.values():
+        for meth in cm.methods.values():
+            check_tree(meth, cm)
+
+    n_guarded = sum(len(c.guarded) for c in model.classes.values()) + len(
+        model.guarded_globals
+    )
+    return _suppress(findings, lines), edges, n_guarded
+
+
+def _suppress(findings: List[Finding], lines: List[str]) -> List[Finding]:
+    out = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
+        key = (f.path, f.line, f.col, f.code, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        line_src = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        m = _IGNORE_RE.search(line_src)
+        if m:
+            codes = m.group(1)
+            if codes is None or f.code in {c.strip() for c in codes.split(",")}:
+                continue
+        out.append(f)
+    return out
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
+    """All three analyses over a file set.  LK002 runs on the UNION of
+    every file's acquisition edges: cross-module nesting (telemetry
+    calling into utils) is exactly where the interesting cycles live."""
+    findings: List[Finding] = []
+    edges: List[Edge] = []
+    n_guarded = 0
+    files = iter_py_files(paths)
+    sources: Dict[str, List[str]] = {}
+    for path in files:
+        f, e, g = analyze_file(path)
+        findings.extend(f)
+        edges.extend(e)
+        n_guarded += g
+    cycle_findings = _detect_cycles(edges)
+    for cf in cycle_findings:
+        if cf.path not in sources:
+            try:
+                with open(cf.path) as fh:
+                    sources[cf.path] = fh.read().splitlines()
+            except OSError:
+                sources[cf.path] = []
+        findings.extend(_suppress([cf], sources[cf.path]))
+    stats = {
+        "files": len(files),
+        "guarded": n_guarded,
+        "edges": len(edges),
+        "findings": len(findings),
+    }
+    return findings, stats
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["cyclonus_tpu"],
+        help="files/directories to lint (default: cyclonus_tpu)",
+    )
+    args = ap.parse_args(argv)
+    findings, stats = lint_paths(args.paths)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        print(f.render())
+    print(
+        f"locklint: {stats['findings']} finding(s), {stats['guarded']} "
+        f"guarded attribute(s), {stats['edges']} acquisition edge(s) in "
+        f"{stats['files']} file(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
