@@ -13,14 +13,21 @@ test:
 # a hot path (tests/test_telemetry.py asserts the same) + the
 # lock-discipline lint (tools/locklint.py — guarded-by, lock-order
 # cycles, leaked guards; see docs/DESIGN.md "Lock discipline") over the
-# whole package.
-lint:
+# whole package + the tensor-contract lint (tools/shapelint.py —
+# shape/dtype/sentinel/tile-alignment contracts of the encoding->kernel
+# pipeline; see docs/DESIGN.md "Tensor contracts") over the engine, the
+# analysis layer, and the worker wire model.
+lint: shapelint
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe
 	python tools/locklint.py cyclonus_tpu
+
+shapelint:
+	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
+	  cyclonus_tpu/worker/model.py
 
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, then run the suite on a
@@ -61,4 +68,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz race bench fmt vet lint cyclonus docker
+.PHONY: test check conformance fuzz race bench fmt vet lint shapelint cyclonus docker

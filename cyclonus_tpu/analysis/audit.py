@@ -294,9 +294,11 @@ def audit_policy_set(
             continue
         refs = _rule_refs(direction, targets, enc)
         # bc[p, m*q]: the rule's peer-side x case footprint
-        bc = (b[:, :, None] & cq[:, None, :]).reshape(p, n_pods_axis * q)
-        a32 = a.astype(np.int32)
-        bc32 = bc.astype(np.int32)
+        bc = (b[:, :, None] & cq[:, None, :]).reshape(p, n_pods_axis * q)  # shape: (P, NQ) bool
+        # explicit int32 BEFORE the matmuls: bool @ bool would upcast
+        # per numpy promotion (shapelint SC002's bool-arithmetic class)
+        a32 = a.astype(np.int32)  # shape: (P, N) int32
+        bc32 = bc.astype(np.int32)  # shape: (P, NQ) int32
         # per-cell firing-rule count over the whole direction
         count = a32.T @ bc32  # [N, N*Q]
         uniq = count == 1

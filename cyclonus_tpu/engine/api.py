@@ -739,10 +739,10 @@ class TpuPolicyEngine:
 
     def _port_case_arrays(self, cases: Sequence[PortCase]):
         vocab = self.encoding.cluster.vocab
-        q_port = np.array([c.port for c in cases], dtype=np.int32)
+        q_port = np.array([c.port for c in cases], dtype=np.int32)  # shape: (Q,) int32
         q_name = np.array(
             [vocab.port_name.get(c.port_name, -1) for c in cases], dtype=np.int32
-        )
+        )  # shape: (Q,) int32; sentinel: -1=unnamed
         # protocols unseen at compile time can match no spec: id -1 (pads
         # are -2, real ids >= 0)
         q_proto = np.array(

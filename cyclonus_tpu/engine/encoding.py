@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..kube.ipaddr import cidr_to_base_and_prefix, ip_to_uint32
+from ..utils import contracts
 from ..kube.netpol import (
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
@@ -122,20 +123,31 @@ class _Vocab:
         return self.proto.setdefault(protocol, len(self.proto))
 
 
+@contracts.checked
 @dataclass
 class ClusterEncoding:
-    """Tensorized cluster: one row per pod, one row per namespace."""
+    """Tensorized cluster: one row per pod, one row per namespace.
+
+    Tensor contracts (tools/shapelint.py + utils/contracts.py; symbol
+    table in docs/DESIGN.md "Tensor contracts"): N pods, M namespaces,
+    L/Lns label pad widths.  Validated on construction under
+    CYCLONUS_SHAPE_CHECK=1."""
 
     vocab: _Vocab
     pod_keys: List[str]  # "ns/name" in row order
-    pod_ns_id: np.ndarray  # [N] int32
-    pod_kv: np.ndarray  # [N, L] int32, pad -1
-    pod_key: np.ndarray  # [N, L] int32, pad -1
-    pod_ip: np.ndarray  # [N] uint32 (0 where invalid)
-    pod_ip_valid: np.ndarray  # [N] bool (parseable IPv4)
+    pod_ns_id: np.ndarray = contracts.tensor("(N,) int32")
+    pod_kv: np.ndarray = contracts.tensor("(N, L) int32", sentinel="-1=pad")
+    pod_key: np.ndarray = contracts.tensor("(N, L) int32", sentinel="-1=pad")
+    # a parse-failure row holds uint32 0 — a REAL address (0.0.0.0) — so
+    # the bool validity column, not the 0, is the ground truth: every
+    # comparison against pod_ip must consult pod_ip_valid (SC003)
+    pod_ip: np.ndarray = contracts.tensor(
+        "(N,) uint32", sentinel="0=invalid", mask="pod_ip_valid"
+    )
+    pod_ip_valid: np.ndarray = contracts.tensor("(N,) bool")
     pod_ips: List[str]  # raw strings, for host-side v6 fallback
-    ns_kv: np.ndarray  # [M, Lns] int32
-    ns_key: np.ndarray  # [M, Lns] int32
+    ns_kv: np.ndarray = contracts.tensor("(M, Lns) int32", sentinel="-1=pad")
+    ns_key: np.ndarray = contracts.tensor("(M, Lns) int32", sentinel="-1=pad")
 
     @property
     def n_pods(self) -> int:
@@ -216,6 +228,11 @@ _STRICT_IPV4_LINES = None  # compiled lazily (module import stays light)
 
 def _encode_pod_ips(ips: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     """(pod_ip uint32 [N], pod_ip_valid bool [N]) for all pods at once.
+
+    Contract (ClusterEncoding.pod_ip): a parse failure fills uint32 0 —
+    a REAL address (0.0.0.0) — with the bool column as ground truth, so
+    every consumer comparison must be pod_ip_valid-guarded (shapelint
+    SC003 enforces this wherever the mask-declared field is compared).
 
     Bulk fast path: ONE multiline regex pass over the joined IP strings
     (the strict octet grammar — exactly what _fast_ipv4_to_uint32
@@ -449,31 +466,51 @@ class _PortSpecBuilder:
         }
 
 
+@contracts.checked
 @dataclass
 class _DirectionEncoding:
-    """Targets + flattened peers for one direction (ingress or egress)."""
+    """Targets + flattened peers for one direction (ingress or egress).
+
+    Tensor contracts: T targets, P flat peers, X except-block pad width.
+    Validated on construction under CYCLONUS_SHAPE_CHECK=1."""
 
     n_targets: int
-    target_ns: np.ndarray  # [T] int32 (-1: namespace unknown to cluster)
-    target_sel: np.ndarray  # [T] int32 selector id
-    # peers, flat:
-    peer_target: np.ndarray  # [P] int32
-    peer_rule_idx: np.ndarray  # [P] int32: peer's index WITHIN its target
-    # (rule provenance for the analysis layer: flat row p is rule
-    # (peer_target[p], peer_rule_idx[p]) of the sorted_targets() order)
-    peer_kind: np.ndarray  # [P] int32
-    peer_ns_kind: np.ndarray  # [P] int32 (pod peers)
-    peer_ns_id: np.ndarray  # [P] int32 (NS_EXACT)
-    peer_ns_sel: np.ndarray  # [P] int32 (NS_SELECTOR)
-    peer_pod_kind: np.ndarray  # [P] int32
-    peer_pod_sel: np.ndarray  # [P] int32
-    # ip peers (IPv4 in-kernel; v6 handled via host rows):
-    ip_base: np.ndarray  # [P] uint32 (pre-masked)
-    ip_mask: np.ndarray  # [P] uint32
-    ip_is_v4: np.ndarray  # [P] bool
-    ex_base: np.ndarray  # [P, X] uint32
-    ex_mask: np.ndarray  # [P, X] uint32
-    ex_valid: np.ndarray  # [P, X] bool
+    # -1: namespace unknown to cluster
+    target_ns: np.ndarray = contracts.tensor("(T,) int32", sentinel="-1=pad")
+    target_sel: np.ndarray = contracts.tensor("(T,) int32")  # selector id
+    # peers, flat (pad peers belong to target -1: zero one-hot row):
+    peer_target: np.ndarray = contracts.tensor("(P,) int32", sentinel="-1=pad")
+    # peer's index WITHIN its target (rule provenance for the analysis
+    # layer: flat row p is rule (peer_target[p], peer_rule_idx[p]) of
+    # the sorted_targets() order)
+    peer_rule_idx: np.ndarray = contracts.tensor("(P,) int32")
+    peer_kind: np.ndarray = contracts.tensor("(P,) int32")
+    peer_ns_kind: np.ndarray = contracts.tensor("(P,) int32")  # (pod peers)
+    peer_ns_id: np.ndarray = contracts.tensor(
+        "(P,) int32", sentinel="-1=pad"
+    )  # (NS_EXACT)
+    peer_ns_sel: np.ndarray = contracts.tensor(
+        "(P,) int32", sentinel="-1=pad"
+    )  # (NS_SELECTOR)
+    peer_pod_kind: np.ndarray = contracts.tensor("(P,) int32")
+    peer_pod_sel: np.ndarray = contracts.tensor("(P,) int32", sentinel="-1=pad")
+    # ip peers (IPv4 in-kernel; v6 handled via host rows).  base/mask
+    # rows are only meaningful where ip_is_v4 — non-v4 rows hold 0,
+    # which as uint32 data would be 0.0.0.0/0 (match everything)
+    ip_base: np.ndarray = contracts.tensor(
+        "(P,) uint32", sentinel="0=inert", mask="ip_is_v4"
+    )  # (pre-masked)
+    ip_mask: np.ndarray = contracts.tensor(
+        "(P,) uint32", sentinel="0=inert", mask="ip_is_v4"
+    )
+    ip_is_v4: np.ndarray = contracts.tensor("(P,) bool")
+    ex_base: np.ndarray = contracts.tensor(
+        "(P, X) uint32", sentinel="0=inert", mask="ex_valid"
+    )
+    ex_mask: np.ndarray = contracts.tensor(
+        "(P, X) uint32", sentinel="0=inert", mask="ex_valid"
+    )
+    ex_valid: np.ndarray = contracts.tensor("(P, X) bool")
     host_ip_rows: List[Tuple[int, IPPeerMatcher]]  # v6 fallback: peer row -> matcher
     port_spec: Dict[str, np.ndarray]  # per-peer port spec arrays
 
@@ -629,18 +666,25 @@ def _encode_direction(
     )
 
 
+@contracts.checked
 @dataclass
 class PolicyEncoding:
-    """Full tensor encoding of a compiled Policy against a cluster."""
+    """Full tensor encoding of a compiled Policy against a cluster.
+
+    Selector-table contracts: S deduped selectors, R matchLabels pad
+    width, E matchExpressions pad width, V expression-values pad
+    width."""
 
     cluster: ClusterEncoding
     ingress: _DirectionEncoding
     egress: _DirectionEncoding
     # selector arrays (shared by both directions):
-    sel_req_kv: np.ndarray
-    sel_exp_op: np.ndarray
-    sel_exp_key: np.ndarray
-    sel_exp_vals: np.ndarray
+    sel_req_kv: np.ndarray = contracts.tensor("(S, R) int32", sentinel="-1=pad")
+    sel_exp_op: np.ndarray = contracts.tensor("(S, E) int32")  # EXP_NONE pad
+    sel_exp_key: np.ndarray = contracts.tensor("(S, E) int32", sentinel="-1=pad")
+    sel_exp_vals: np.ndarray = contracts.tensor(
+        "(S, E, V) int32", sentinel="-1=pad"
+    )
     n_selectors: int
 
 

@@ -29,6 +29,7 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
+from ..utils import contracts
 from .encoding import (
     EXP_DOES_NOT_EXIST,
     EXP_EXISTS,
@@ -49,6 +50,14 @@ from .encoding import (
 )
 
 
+@contracts.args(
+    sel_req_kv="(S, R) int32",
+    sel_exp_op="(S, E) int32",
+    sel_exp_key="(S, E) int32",
+    sel_exp_vals="(S, E, V) int32",
+    kv="(N, L) int32",
+    key="(N, L) int32",
+)
 def selector_match(
     sel_req_kv: jnp.ndarray,  # [S, R]
     sel_exp_op: jnp.ndarray,  # [S, E]
@@ -92,6 +101,13 @@ def selector_match(
     return req_ok & jnp.all(exp_ok, axis=-1)
 
 
+@contracts.args(
+    selpod="(S, N) bool",
+    selns="(S, M) bool",
+    pod_ns_id="(N,) int32",
+    pod_ip="(N,) uint32",
+    pod_ip_valid="(N,) bool",
+)
 def direction_precompute(
     enc: Dict[str, jnp.ndarray],
     selpod: jnp.ndarray,  # [S, N] selector-vs-pod-labels
@@ -134,8 +150,14 @@ def direction_precompute(
         & pod_ip_valid[None, :]
         & ((pod_ip[None, :] & enc["ip_mask"][:, None]) == enc["ip_base"][:, None])
     )  # [P, N]
+    # pod_ip's 0-sentinel is a real address (0.0.0.0): an invalid pod
+    # must never register as inside an except block, so the validity
+    # mask guards this comparison too — today in_cidr already zeroes
+    # those columns, but the except term must hold the contract on its
+    # own (shapelint SC003 on the pod_ip/pod_ip_valid declaration)
     in_except = jnp.any(
         enc["ex_valid"][:, :, None]
+        & pod_ip_valid[None, None, :]
         & (
             (pod_ip[None, None, :] & enc["ex_mask"][:, :, None])
             == enc["ex_base"][:, :, None]
@@ -154,6 +176,9 @@ def direction_precompute(
     return {"tmatch": tmatch, "has_target": has_target, "peer_match": peer_match}
 
 
+@contracts.args(
+    q_port="(Q,) int32", q_name="(Q,) int32", q_proto="(Q,) int32"
+)
 def port_spec_allows(
     spec: Dict[str, jnp.ndarray],
     q_port: jnp.ndarray,  # [Q] int32

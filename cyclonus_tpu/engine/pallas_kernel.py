@@ -474,8 +474,8 @@ def _verdict_counts_pallas_rect(
         tmatch_i, has_i, jnp.moveaxis(tallow_i, 2, 0).astype(od),
         valid_dst, valid_src,
     )
-    kt_e = _kt_for(tm_e.shape[0])
-    kt_i = _kt_for(tm_i.shape[0])
+    kt_e = _kt_for(tm_e.shape[0])  # tile: 128
+    kt_i = _kt_for(tm_i.shape[0])  # tile: 128
     single_chunk = kt_e >= tm_e.shape[0] and kt_i >= tm_i.shape[0]
     bs, bd = _tiles_for(
         kt_e, kt_i, ns,

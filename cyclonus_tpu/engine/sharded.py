@@ -143,10 +143,10 @@ def _pad_pod_arrays(tensors: Dict, n_pods: int, n_dev: int) -> Tuple[Dict, int]:
     )
     t["pod_ip"] = np.concatenate(
         [tensors["pod_ip"], np.zeros((pad,), np.uint32)]
-    )
+    )  # shape: (N,) uint32; sentinel: 0=invalid; mask: pod_ip_valid
     t["pod_ip_valid"] = np.concatenate(
         [tensors["pod_ip_valid"], np.zeros((pad,), bool)]
-    )
+    )  # shape: (N,) bool
     for direction in ("ingress", "egress"):
         d = t[direction]
         if "host_ip_match" in d:

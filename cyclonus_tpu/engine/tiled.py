@@ -114,7 +114,7 @@ def _precompute(tensors: Dict) -> Dict[str, Dict[str, jnp.ndarray]]:
         n_p, n = pre["peer_match"].shape
         peer_allow = (
             pre["peer_match"][:, :, None] & pport[:, None, :]
-        ).reshape(n_p, n * q)
+        ).reshape(n_p, n * q)  # shape: (P, NQ)
         tallow = jnp.matmul(
             m_tp_onehot(enc).astype(jnp.bfloat16),
             peer_allow.astype(jnp.bfloat16),

@@ -26,12 +26,25 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, ClassVar, Dict, List, Optional
+
+from ..utils import contracts
 
 
 @dataclass
 class Request:
     """model.go:26-48."""
+
+    # Wire dtype contract (tools/shapelint.py checks the emit side
+    # statically; contracts.check_wire validates real payloads under
+    # CYCLONUS_SHAPE_CHECK=1).  Required keys are the frozen reference
+    # shape; `optional=True` marks extensions (module docstring rules).
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
+        "Key": contracts.wire(str),
+        "Protocol": contracts.wire(str),
+        "Host": contracts.wire(str),
+        "Port": contracts.wire(int),
+    }
 
     key: str
     protocol: str
@@ -52,15 +65,20 @@ class Request:
         ]
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "Key": self.key,
             "Protocol": self.protocol,
             "Host": self.host,
             "Port": self.port,
         }
+        if contracts.CHECK:
+            contracts.check_wire("Request", d, self.WIRE)
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Request":
+        if contracts.CHECK:
+            contracts.check_wire("Request", d, Request.WIRE)
         return Request(
             key=d["Key"], protocol=d["Protocol"], host=d["Host"], port=d["Port"]
         )
@@ -74,6 +92,15 @@ class Batch:
     docstring's compatibility rules): when the driver is recording a
     timeline, it stamps its trace id and current span path here so the
     worker's spans join the same trace, nested under the issuing step."""
+
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
+        "Namespace": contracts.wire(str),
+        "Pod": contracts.wire(str),
+        "Container": contracts.wire(str),
+        "Requests": contracts.wire(list),
+        "TraceId": contracts.wire(str, optional=True),
+        "ParentSpan": contracts.wire(str, optional=True),
+    }
 
     namespace: str
     pod: str
@@ -96,11 +123,16 @@ class Batch:
             d["TraceId"] = self.trace_id
             if self.parent_span:
                 d["ParentSpan"] = self.parent_span
+        if contracts.CHECK:
+            contracts.check_wire("Batch", d, self.WIRE)
         return json.dumps(d)
 
     @staticmethod
     def from_json(text: str) -> "Batch":
         d = json.loads(text)
+        # tolerant parse on purpose (module docstring): missing required
+        # keys default rather than raise, so no check_wire here — an old
+        # peer's payload must keep parsing
         return Batch(
             namespace=d.get("Namespace", ""),
             pod=d.get("Pod", ""),
@@ -121,6 +153,14 @@ class Result:
     histogram, and the worker's recorded trace events riding back for
     the merged driver+worker timeline."""
 
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
+        "Request": contracts.wire(dict),
+        "Output": contracts.wire(str),
+        "Error": contracts.wire(str),
+        "LatencyMs": contracts.wire(float, optional=True),
+        "TraceEvents": contracts.wire(list, optional=True),
+    }
+
     request: Request
     output: str = ""
     error: str = ""
@@ -140,10 +180,16 @@ class Result:
             d["LatencyMs"] = self.latency_ms
         if self.trace_events:
             d["TraceEvents"] = self.trace_events
+        if contracts.CHECK:
+            contracts.check_wire("Result", d, self.WIRE)
         return d
 
     @staticmethod
     def from_dict(d: dict) -> "Result":
+        # parse side is tolerant of ABSENT keys (old peers), but a
+        # present key with a drifted type is a wire break worth catching
+        if contracts.CHECK:
+            contracts.check_wire("Result", d, Result.WIRE, partial=True)
         latency = d.get("LatencyMs")
         events = d.get("TraceEvents")
         return Result(

@@ -176,6 +176,11 @@ class TestBenchGuards:
         # counters live on exactly the code paths that were annotated
         assert "cyclonus_tpu_slab_ops_cache_hits_total" in tel["metrics"]
         assert "cyclonus_tpu_slab_ops_cache_misses_total" in tel["metrics"]
+        # the tensor-contract counter only exists under
+        # CYCLONUS_SHAPE_CHECK=1 (utils/contracts.py registers it on
+        # first check) — its ABSENCE here proves the production strip
+        # is real, not just cheap
+        assert "cyclonus_tpu_contract_checks_total" not in tel["metrics"]
         assert "engine.dispatch" in tel["phases"]
         assert any(
             e["path"].startswith("counts.") for e in tel["flight_recorder"]

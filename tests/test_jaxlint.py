@@ -373,3 +373,102 @@ class TestJX005HostCallbacks:
             for f in jaxlint.iter_py_files([pkg_dir]):
                 findings.extend(jaxlint.lint_file(f))
             assert findings == [], "\n".join(x.render() for x in findings)
+
+
+class TestJX006HostNumpySeam:
+    """One level of call-site inference into non-jit helpers: np.* fed a
+    traced value through a helper call silently falls back to host
+    numpy (the seam shapelint's propagation crosses)."""
+
+    def test_np_in_helper_reached_with_traced_arg(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            def host_helper(x, cfg):
+                return np.maximum(x, 0)
+
+            @jax.jit
+            def kernel(a):
+                return host_helper(a, {"k": 1})
+            """,
+        )
+        assert _codes(findings) == ["JX006"]
+        assert "host_helper" in findings[0].message
+        assert "kernel" in findings[0].message
+
+    def test_untraced_args_stay_clean(self, tmp_path):
+        """A helper called only with host values (shapes, statics) may
+        use np freely."""
+        findings = _lint_source(
+            tmp_path,
+            """
+            def plan(n):
+                return np.arange(n)
+
+            @jax.jit
+            def kernel(a):
+                idx = plan(a.shape[0])
+                return a + jnp.asarray(idx)
+            """,
+        )
+        assert findings == []
+
+    def test_jnp_helper_is_clean(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            def device_helper(x):
+                return jnp.maximum(x, 0)
+
+            @jax.jit
+            def kernel(a):
+                return device_helper(a)
+            """,
+        )
+        assert findings == []
+
+    def test_jit_callee_not_double_reported(self, tmp_path):
+        """A helper that is itself jit-traced is linted once as JX001,
+        never re-coded as JX006."""
+        findings = _lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def inner(x):
+                return np.maximum(x, 0)
+
+            @jax.jit
+            def kernel(a):
+                return inner(a)
+            """,
+        )
+        assert _codes(findings) == ["JX001"]
+
+    def test_suppression(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            def host_helper(x):
+                return np.maximum(x, 0)  # jaxlint: ignore[JX006]
+
+            @jax.jit
+            def kernel(a):
+                return host_helper(a)
+            """,
+        )
+        assert findings == []
+
+    def test_nested_helper_not_double_reported(self, tmp_path):
+        """A helper DEFINED INSIDE the jit body is covered by the
+        nested-def taint (JX001) — JX006 must not re-report it."""
+        findings = _lint_source(
+            tmp_path,
+            """
+            @jax.jit
+            def kernel(a):
+                def helper(x):
+                    return np.maximum(x, 0)
+                return helper(a)
+            """,
+        )
+        assert _codes(findings) == ["JX001"]

@@ -113,6 +113,59 @@ class TestWireCompatibility:
         assert r2.latency_ms == 7.25
         assert r2.trace_events == r.trace_events
 
+    def test_wire_contract_golden(self):
+        """The dtype-contract half of the compat rules (utils/contracts
+        + tools/shapelint.py): the WIRE declarations ARE the protocol.
+        This golden pins every key's (type, optional) pair — changing a
+        contract on an optional field (or demoting a required one)
+        fails here before it can ship a silent wire break.  Update the
+        golden AND the module docstring together, never one alone."""
+        from cyclonus_tpu.worker.model import Batch, Request, Result
+
+        golden = {
+            Request: {
+                "Key": (str, False),
+                "Protocol": (str, False),
+                "Host": (str, False),
+                "Port": (int, False),
+            },
+            Batch: {
+                "Namespace": (str, False),
+                "Pod": (str, False),
+                "Container": (str, False),
+                "Requests": (list, False),
+                "TraceId": (str, True),
+                "ParentSpan": (str, True),
+            },
+            Result: {
+                "Request": (dict, False),
+                "Output": (str, False),
+                "Error": (str, False),
+                "LatencyMs": (float, True),
+                "TraceEvents": (list, True),
+            },
+        }
+        for cls, want in golden.items():
+            got = {k: (wf.type, wf.optional) for k, wf in cls.WIRE.items()}
+            assert got == want, f"{cls.__name__} wire contract drifted"
+
+    def test_wire_contract_statically_linted(self):
+        """shapelint's emit-side check runs over worker/model.py in
+        `make lint`; assert it stays clean here too so a local edit
+        can't land between lint runs."""
+        import os
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        _sys.path.insert(0, os.path.join(repo, "tools"))
+        import shapelint
+
+        findings, stats = shapelint.lint_paths(
+            [os.path.join(repo, "cyclonus_tpu", "worker", "model.py")]
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+        assert stats["contracts"] >= 15, stats  # 3 WIRE maps
+
 
 class _FakeProc:
     def __init__(self, returncode=0, stdout="CONNECTED", stderr=""):

@@ -26,6 +26,14 @@ flags, via a per-function taint pass seeded from the traced parameters:
          host_callback — each staged call round-trips device->host
          EVERY step, serializing the dispatch pipeline (fine for a
          debug session, never for a hot path)
+  JX006  host-numpy seam one level out: a module-level helper that is
+         NOT itself jit-traced, called from a jit body with traced
+         arguments, whose body feeds those parameters to `np.*` — the
+         call silently falls back to host numpy (np dispatches via
+         __array__, concretizing the tracer) even though the helper
+         looks like innocent host code in isolation.  This is the
+         host/device seam the tensor-contract lint (tools/shapelint.py)
+         propagates shapes across.
 
 `static_argnames` / `static_argnums` parameters are exempt from taint
 (branching on a static is the whole point of statics), as are shape /
@@ -542,6 +550,80 @@ class TaintChecker:
                 )
 
 
+def _helper_seam_findings(
+    info: ModuleInfo,
+    path: str,
+    checkers: List[TaintChecker],
+    jit_ids: Set[int],
+) -> List[Finding]:
+    """JX006: one level of call-site inference into non-jit module
+    helpers.  For each helper called from a jit body with traced
+    arguments, re-run the taint pass over the helper with ONLY those
+    parameters traced, and surface its np-on-tracer hits."""
+    # defs lexically nested inside a jit body are already covered by the
+    # nested-def taint pass (JX001 at the same line) — never re-code them
+    nested_ids: Set[int] = set()
+    for checker in checkers:
+        for node in ast.walk(checker.func):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not checker.func:
+                    nested_ids.add(id(node))
+    # helper name -> (tainted param names, one caller name for the message)
+    reached: Dict[str, Tuple[Set[str], str]] = {}
+    for checker in checkers:
+        caller = getattr(checker.func, "name", "<lambda>")
+        for node in ast.walk(checker.func):
+            if not (
+                isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            ):
+                continue
+            for callee in info.funcs.get(node.func.id, []):
+                if id(callee) in jit_ids or id(callee) in nested_ids:
+                    continue  # already linted as/inside a jit function
+                pos = [
+                    a.arg
+                    for a in callee.args.posonlyargs + callee.args.args
+                ]
+                tainted: Set[str] = set()
+                for i, a in enumerate(node.args):
+                    if i < len(pos) and checker.taints(a):
+                        tainted.add(pos[i])
+                for kw in node.keywords:
+                    if kw.arg in pos and checker.taints(kw.value):
+                        tainted.add(kw.arg)
+                if tainted:
+                    entry = reached.setdefault(
+                        node.func.id, (set(), caller)
+                    )
+                    entry[0].update(tainted)
+    out: List[Finding] = []
+    for fname, (tainted, caller) in reached.items():
+        for callee in info.funcs.get(fname, []):
+            if id(callee) in jit_ids or id(callee) in nested_ids:
+                continue
+            a = callee.args
+            all_params = {
+                x.arg for x in a.posonlyargs + a.args + a.kwonlyargs
+            }
+            sub = TaintChecker(info, path, callee, all_params - tainted)
+            for f in sub.run():
+                if f.code == "JX001" and "numpy call" in f.message:
+                    out.append(
+                        Finding(
+                            f.path,
+                            f.line,
+                            f.col,
+                            "JX006",
+                            f"numpy call on a traced value inside host "
+                            f"helper '{fname}' reached from jit-traced "
+                            f"'{caller}' (silent host-numpy fallback "
+                            f"concretizes the tracer; use jnp or keep "
+                            f"np.* out of the traced path)",
+                        )
+                    )
+    return out
+
+
 def lint_file(path: str) -> List[Finding]:
     with open(path, "r") as f:
         source = f.read()
@@ -551,12 +633,17 @@ def lint_file(path: str) -> List[Finding]:
         return [Finding(path, e.lineno or 0, 0, "JX000", f"syntax error: {e.msg}")]
     info = ModuleInfo(tree)
     findings: List[Finding] = []
-    for func, statics in collect_jit_functions(info, tree):
+    jit_funcs = collect_jit_functions(info, tree)
+    jit_ids = {id(f) for f, _ in jit_funcs}
+    checkers: List[TaintChecker] = []
+    for func, statics in jit_funcs:
         # JX003 applies to the jit function's own signature even before
         # the taint pass
         checker = TaintChecker(info, path, func, statics)
         checker._check_defaults(func)
         findings.extend(checker.run())
+        checkers.append(checker)
+    findings.extend(_helper_seam_findings(info, path, checkers, jit_ids))
     lines = source.splitlines()
     out = []
     seen = set()

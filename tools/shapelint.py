@@ -1,0 +1,1458 @@
+#!/usr/bin/env python
+"""Tensor-contract static lint: shape/dtype/sentinel checks for the
+encoding -> kernel pipeline (third leg of the linter family next to
+tools/jaxlint.py and tools/locklint.py).
+
+The dense tensors engine/encoding.py produces mean exactly what the
+scalar oracle assumes — `-1` pad ids, `-2` never-match sentinels,
+`uint32` IPs beside `int32` ids, lane/sublane tile round-ups — but
+those meanings used to live only in comments.  This lint reads the
+contracts where the tensors are born (`contracts.tensor(...)` dataclass
+descriptors, `@contracts.args(...)` decorators, and trailing
+`# shape: (N, L) int32` / legacy `# [N, L] int32, pad -1` comments on
+fields and parameters), propagates symbolic shapes/dtypes through
+np/jnp constructors, reshape/stack/broadcast and one level of same-run
+call-site return inference, and reports:
+
+  SC001  shape-contract violation: a declared field/parameter built or
+         passed with rank, literal dims, or dtype inconsistent with its
+         declaration (including rank-changing implicit broadcast of two
+         declared arrays, and wire-contract drift in worker/model.py)
+  SC002  dtype-promotion hazard: cross-signedness comparison/bitop
+         (uint32 vs int32 silently widens to int64), arithmetic on two
+         bool arrays (upcasts; use logical ops), or an array literal
+         with bare float elements and no dtype (poisons to float64
+         under x64)
+  SC003  sentinel misuse: a field declared with a validity mask
+         (`mask="pod_ip_valid"`) compared without its mask in the same
+         statement, or a declared-sentinel array filled with a negative
+         fill outside its sentinel set
+  SC004  tile alignment: a dim reaching a pallas `pl.BlockSpec` lane
+         axis (or asserted by a trailing `# tile: <k>` comment) that
+         cannot be proven a multiple of the tile — flags hand-rolled
+         round-up math the prover can't discharge and misaligned
+         literals
+
+Contracts declared in ANY linted file are visible to every other file
+in the same run (the registry is keyed by field name), so kernel.py's
+`enc["ip_mask"]` picks up the dtype `_DirectionEncoding.ip_mask`
+declares in encoding.py.
+
+Suppress a finding with `# shapelint: ignore` or
+`# shapelint: ignore[SC001,...]` on the offending line (same convention
+as jaxlint/locklint).
+
+Usage: python tools/shapelint.py [paths...]  (default: cyclonus_tpu/engine)
+Exit status 1 iff findings remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+SIGNED = {"int8", "int16", "int32", "int64"}
+UNSIGNED = {"uint8", "uint16", "uint32", "uint64"}
+FLOATS = {"float32", "float64", "bfloat16"}
+DTYPES = SIGNED | UNSIGNED | FLOATS | {"bool"}
+ARRAY_MODULES = {"np", "numpy", "jnp"}
+LANE = 128
+
+_IGNORE_RE = re.compile(r"#\s*shapelint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+_CANON_RE = re.compile(
+    r"#\s*shape:\s*[(\[]([^)\]]*)[)\]]\s*([A-Za-z_][A-Za-z0-9_]*)?"
+)
+_SENTINEL_RE = re.compile(r"sentinel:\s*([-0-9=a-zA-Z_,\s]+?)(?:;|$)")
+_MASK_RE = re.compile(r"mask:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_LEGACY_RE = re.compile(
+    r"#\s*\[([A-Za-z0-9_,\s]+)\]\s*([A-Za-z_][A-Za-z0-9_]*)?"
+)
+_LEGACY_PAD_RE = re.compile(r"\bpad\s+(-?\d+)")
+_TILE_RE = re.compile(r"#\s*tile:\s*(\d+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclass(frozen=True)
+class Spec:
+    """A declared tensor contract (static twin of contracts.TensorSpec)."""
+
+    dims: Tuple[object, ...]  # int literals or str symbols
+    dtype: Optional[str] = None
+    sentinel: Tuple[int, ...] = ()
+    mask: Optional[str] = None
+
+    def render(self) -> str:
+        return f"({', '.join(str(d) for d in self.dims)}) {self.dtype or ''}".strip()
+
+
+_NOFILL = object()
+
+
+@dataclass
+class SI:
+    """Inferred shape info for one expression."""
+
+    rank: Optional[int] = None
+    dims: Optional[Tuple[object, ...]] = None
+    dtype: Optional[str] = None
+    fill: object = _NOFILL
+
+
+def _spec_si(spec: Spec) -> SI:
+    return SI(rank=len(spec.dims), dims=spec.dims, dtype=spec.dtype)
+
+
+def _parse_dims(raw: str) -> Optional[Tuple[object, ...]]:
+    dims: List[object] = []
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok.lstrip("-").isdigit():
+            dims.append(int(tok))
+        elif re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", tok):
+            dims.append(tok)
+        else:
+            return None
+    return tuple(dims)
+
+
+def parse_comment_spec(line_src: str) -> Optional[Spec]:
+    """Trailing-comment contract: canonical `# shape: (N, L) int32;
+    sentinel: -1=pad; mask: m` or legacy `# [N, L] int32, pad -1`."""
+    m = _CANON_RE.search(line_src)
+    legacy = False
+    if m is None:
+        m = _LEGACY_RE.search(line_src)
+        legacy = True
+    if m is None:
+        return None
+    dims = _parse_dims(m.group(1))
+    if dims is None:
+        return None
+    dtype = m.group(2)
+    if dtype is not None and dtype not in DTYPES:
+        if not legacy:
+            return None  # canonical grammar: a bad dtype is a typo
+        dtype = None  # legacy comments carry prose after the dims
+    rest = line_src[m.end():]
+    sentinel: List[int] = []
+    mask = None
+    if legacy:
+        pm = _LEGACY_PAD_RE.search(rest)
+        if pm:
+            sentinel.append(int(pm.group(1)))
+    else:
+        sm = _SENTINEL_RE.search(rest)
+        if sm:
+            for part in sm.group(1).split(","):
+                val = part.strip().split("=")[0].strip()
+                if val.lstrip("-").isdigit():
+                    sentinel.append(int(val))
+        km = _MASK_RE.search(rest)
+        if km:
+            mask = km.group(1)
+    return Spec(dims, dtype, tuple(sentinel), mask)
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    return None
+
+
+def _dim_of(node: ast.AST) -> object:
+    c = _const_int(node)
+    if c is not None:
+        return c
+    if isinstance(node, ast.Name):
+        return node.id
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return None
+
+
+def resolve_dtype(node: ast.AST) -> Optional[str]:
+    """np.int32 / jnp.uint32 / 'int32' / bool / np.bool_ -> canonical."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+        if name == "bool_":
+            return "bool"
+        if name in DTYPES:
+            return name
+        return None
+    if isinstance(node, ast.Name):
+        if node.id == "bool":
+            return "bool"
+        if node.id in DTYPES:
+            return node.id
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in DTYPES else None
+    return None
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _contracts_tensor_call(node: ast.AST) -> Optional[Spec]:
+    """`contracts.tensor("(N, L) int32", sentinel=..., mask=...)` ->
+    Spec (the static read of utils/contracts.tensor)."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name != "tensor":
+        return None
+    arg = node.args[0]
+    if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+        return None
+    m = re.match(
+        r"^\s*[(\[]([^)\]]*)[)\]]\s*([A-Za-z_][A-Za-z0-9_]*)?\s*$", arg.value
+    )
+    if not m:
+        return None
+    dims = _parse_dims(m.group(1))
+    if dims is None:
+        return None
+    dtype = m.group(2) if m.group(2) in DTYPES else None
+    sentinel: List[int] = []
+    mask = None
+    for kw in node.keywords:
+        if kw.arg == "sentinel" and isinstance(kw.value, ast.Constant):
+            for part in str(kw.value.value).split(","):
+                val = part.strip().split("=")[0].strip()
+                if val.lstrip("-").isdigit():
+                    sentinel.append(int(val))
+        elif kw.arg == "mask" and isinstance(kw.value, ast.Constant):
+            mask = str(kw.value.value)
+    return Spec(dims, dtype, tuple(sentinel), mask)
+
+
+@dataclass
+class ModuleScan:
+    path: str
+    tree: ast.Module
+    lines: List[str]
+    # class name -> ordered {field: Spec}
+    class_contracts: Dict[str, Dict[str, Spec]] = field(default_factory=dict)
+    # function name -> {param: Spec}
+    func_contracts: Dict[str, Dict[str, Spec]] = field(default_factory=dict)
+    # class name -> {wire key: optional?}
+    wire_contracts: Dict[str, Dict[str, bool]] = field(default_factory=dict)
+    functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    n_annotations: int = 0
+
+
+def _param_specs(scan: ModuleScan, fn: ast.FunctionDef) -> Dict[str, Spec]:
+    """@contracts.args(...) kwargs + trailing comments on param lines."""
+    out: Dict[str, Spec] = {}
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = dec.func.attr if isinstance(dec.func, ast.Attribute) else (
+                dec.func.id if isinstance(dec.func, ast.Name) else None
+            )
+            if name == "args":
+                for kw in dec.keywords:
+                    if kw.arg and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        sp = parse_comment_spec(f"# shape: {kw.value.value}")
+                        if sp:
+                            out[kw.arg] = sp
+    a = fn.args
+    for arg in a.posonlyargs + a.args + a.kwonlyargs:
+        if arg.arg in out:
+            continue
+        if 0 < arg.lineno <= len(scan.lines):
+            sp = parse_comment_spec(scan.lines[arg.lineno - 1])
+            if sp:
+                out[arg.arg] = sp
+    return out
+
+
+def scan_module(path: str, source: str) -> Optional[ModuleScan]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    scan = ModuleScan(path, tree, source.splitlines())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            scan.functions.setdefault(node.name, node)
+            specs = _param_specs(scan, node)
+            if specs:
+                scan.func_contracts[node.name] = specs
+                scan.n_annotations += len(specs)
+        elif isinstance(node, ast.ClassDef):
+            scan.classes[node.name] = node
+            fields: Dict[str, Spec] = {}
+            wire: Dict[str, bool] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    sp = None
+                    if stmt.value is not None:
+                        sp = _contracts_tensor_call(stmt.value)
+                    if sp is None and 0 < stmt.lineno <= len(scan.lines):
+                        sp = parse_comment_spec(scan.lines[stmt.lineno - 1])
+                    if sp:
+                        fields[stmt.target.id] = sp
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    targets = [stmt.target]
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Name)
+                        and t.id == "WIRE"
+                        and isinstance(stmt.value, ast.Dict)
+                    ):
+                        for k, v in zip(stmt.value.keys, stmt.value.values):
+                            if not (
+                                isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)
+                            ):
+                                continue
+                            optional = False
+                            if isinstance(v, ast.Call):
+                                for kw in v.keywords:
+                                    if kw.arg == "optional" and isinstance(
+                                        kw.value, ast.Constant
+                                    ):
+                                        optional = bool(kw.value.value)
+                                if (
+                                    len(v.args) > 1
+                                    and isinstance(v.args[1], ast.Constant)
+                                ):
+                                    optional = bool(v.args[1].value)
+                            wire[k.value] = optional
+            if fields:
+                scan.class_contracts[node.name] = fields
+                scan.n_annotations += len(fields)
+            if wire:
+                scan.wire_contracts[node.name] = wire
+                scan.n_annotations += len(wire)
+    return scan
+
+
+@dataclass
+class Registry:
+    """Contracts merged across every file in the run."""
+
+    class_contracts: Dict[str, Dict[str, Spec]] = field(default_factory=dict)
+    func_contracts: Dict[str, Dict[str, Spec]] = field(default_factory=dict)
+    field_specs: Dict[str, Spec] = field(default_factory=dict)
+    masked: Dict[str, str] = field(default_factory=dict)
+
+    def absorb(self, scan: ModuleScan) -> None:
+        for cls, fields in scan.class_contracts.items():
+            self.class_contracts.setdefault(cls, fields)
+            for name, sp in fields.items():
+                self.field_specs.setdefault(name, sp)
+                if sp.mask:
+                    self.masked.setdefault(name, sp.mask)
+        for fn, specs in scan.func_contracts.items():
+            self.func_contracts.setdefault(fn, specs)
+            for name, sp in specs.items():
+                if sp.mask:
+                    self.masked.setdefault(name, sp.mask)
+
+
+CTOR_FULL = {"full"}
+CTOR_FILLED = {"zeros": 0, "ones": 1, "empty": None}
+CTOR_ARRAY = {"array", "asarray", "ascontiguousarray"}
+
+
+def _unify_si(infos: Sequence[Optional[SI]]) -> Optional[SI]:
+    """Merge return-path inferences: keep an attribute only when no two
+    KNOWN values disagree (unknown agrees with everything)."""
+    known = [i for i in infos if i is not None]
+    if not known:
+        return None
+    out = SI()
+    ranks = {i.rank for i in known if i.rank is not None}
+    if len(ranks) == 1:
+        out.rank = ranks.pop()
+    dtypes = {i.dtype for i in known if i.dtype is not None}
+    if len(dtypes) == 1:
+        out.dtype = dtypes.pop()
+    return out
+
+
+class Inferencer:
+    """Symbolic shape/dtype propagation over one module, with one level
+    of same-run call-site return inference."""
+
+    def __init__(self, scan: ModuleScan, registry: Registry):
+        self.scan = scan
+        self.registry = registry
+        self._ret_cache: Dict[str, object] = {}
+        self._inferring: Set[str] = set()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _shape_dims(self, node: ast.AST) -> Optional[Tuple[object, ...]]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(_dim_of(e) for e in node.elts)
+        c = _const_int(node)
+        if c is not None:
+            return (c,)
+        if isinstance(node, ast.Name):
+            return (node.id,)
+        return None
+
+    def _literal_rank(self, node: ast.AST) -> Optional[int]:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            if any(isinstance(e, (ast.List, ast.Tuple)) for e in node.elts):
+                return 2
+            return 1
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            return 1
+        return None
+
+    def _dtype_kw(self, call: ast.Call, pos: Optional[int]) -> Optional[str]:
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return resolve_dtype(kw.value)
+        if pos is not None and len(call.args) > pos:
+            return resolve_dtype(call.args[pos])
+        return None
+
+    # -- call-site return inference (one level) ----------------------------
+
+    def _returns_of(self, name: str) -> object:
+        """Unified SI (or tuple of SIs) of a same-module function's
+        return expressions, inferred with the callee's own env."""
+        if name in self._ret_cache:
+            return self._ret_cache[name]
+        fn = self.scan.functions.get(name)
+        if fn is None or name in self._inferring:
+            return None
+        self._inferring.add(name)
+        try:
+            env: Dict[str, object] = {}
+            for p, sp in self.scan.func_contracts.get(name, {}).items():
+                env[p] = _spec_si(sp)
+            rets: List[ast.AST] = []
+
+            def walk(stmts: List[ast.stmt]) -> None:
+                for s in stmts:
+                    if isinstance(s, ast.Return) and s.value is not None:
+                        rets.append(s.value)
+                    elif isinstance(s, ast.Assign):
+                        self.bind(s.targets, self.infer(s.value, env), env)
+                    elif isinstance(
+                        s, (ast.If, ast.For, ast.While, ast.With, ast.Try)
+                    ):
+                        for attr in ("body", "orelse", "finalbody"):
+                            walk(getattr(s, attr, []) or [])
+                        for h in getattr(s, "handlers", []):
+                            walk(h.body)
+
+            walk(fn.body)
+            vals = [self.infer(r, env) for r in rets]
+            if vals and all(isinstance(v, tuple) for v in vals):
+                width = {len(v) for v in vals}
+                if len(width) == 1:
+                    w = width.pop()
+                    out: object = tuple(
+                        _unify_si([v[i] for v in vals]) for i in range(w)
+                    )
+                else:
+                    out = None
+            else:
+                out = _unify_si(
+                    [v if isinstance(v, SI) else None for v in vals]
+                )
+            self._ret_cache[name] = out
+            return out
+        finally:
+            self._inferring.discard(name)
+
+    # -- binding -----------------------------------------------------------
+
+    def bind(
+        self, targets: List[ast.AST], value: object, env: Dict[str, object]
+    ) -> None:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                env[t.id] = value
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                if isinstance(value, tuple) and len(value) == len(t.elts):
+                    for el, v in zip(t.elts, value):
+                        self.bind([el], v, env)
+                else:
+                    for el in t.elts:
+                        self.bind([el], None, env)
+
+    # -- inference ---------------------------------------------------------
+
+    def infer(self, e: ast.AST, env: Dict[str, object]) -> object:
+        if isinstance(e, ast.Name):
+            return env.get(e.id)
+        if isinstance(e, ast.IfExp):
+            return _unify_si(
+                [
+                    v if isinstance(v, SI) else None
+                    for v in (self.infer(e.body, env), self.infer(e.orelse, env))
+                ]
+            )
+        if isinstance(e, ast.Tuple):
+            return tuple(self.infer(el, env) for el in e.elts)
+        if isinstance(e, ast.Subscript):
+            # dict-style access to a declared field: d["ip_mask"]
+            if isinstance(e.slice, ast.Constant) and isinstance(
+                e.slice.value, str
+            ):
+                sp = self.registry.field_specs.get(e.slice.value)
+                if sp is not None:
+                    return _spec_si(sp)
+                return None
+            base = self.infer(e.value, env)
+            if isinstance(base, SI) and base.dtype:
+                return SI(dtype=base.dtype)  # indexing keeps the dtype
+            return None
+        if isinstance(e, ast.Attribute):
+            sp = self.registry.field_specs.get(e.attr)
+            if sp is None:
+                return None
+            if isinstance(e.value, ast.Name) and e.value.id in ARRAY_MODULES:
+                return None  # np.int32 etc., not a field access
+            return _spec_si(sp)
+        if isinstance(e, ast.BinOp):
+            left = self.infer(e.left, env)
+            right = self.infer(e.right, env)
+            lt = left.dtype if isinstance(left, SI) else None
+            rt = right.dtype if isinstance(right, SI) else None
+            if lt and rt and lt == rt:
+                return SI(dtype=lt)
+            return None
+        if isinstance(e, ast.UnaryOp):
+            return self.infer(e.operand, env)
+        if isinstance(e, ast.Call):
+            return self._infer_call(e, env)
+        return None
+
+    def _infer_call(self, e: ast.Call, env: Dict[str, object]) -> object:
+        f = e.func
+        # method calls ------------------------------------------------------
+        if isinstance(f, ast.Attribute):
+            root = _attr_root(f)
+            if root in ARRAY_MODULES:
+                return self._infer_np(f.attr, e, env)
+            base = self.infer(f.value, env)
+            if f.attr == "astype":
+                dt = resolve_dtype(e.args[0]) if e.args else None
+                out = SI(dtype=dt)
+                if isinstance(base, SI):
+                    out.rank, out.dims = base.rank, base.dims
+                return out
+            if f.attr == "reshape":
+                shape_args = e.args
+                if len(shape_args) == 1 and isinstance(
+                    shape_args[0], (ast.Tuple, ast.List)
+                ):
+                    shape_args = shape_args[0].elts
+                dims = tuple(_dim_of(a) for a in shape_args)
+                dt = base.dtype if isinstance(base, SI) else None
+                if len(dims) == 1 and dims[0] == -1:
+                    return SI(rank=1, dtype=dt)
+                return SI(rank=len(dims), dims=dims, dtype=dt)
+            if f.attr in ("copy", "T"):
+                return base
+            return None
+        return None
+
+    def _infer_np(self, name: str, e: ast.Call, env: Dict[str, object]) -> object:
+        if name in CTOR_FULL and e.args:
+            dims = self._shape_dims(e.args[0])
+            fill = _const_int(e.args[1]) if len(e.args) > 1 else None
+            return SI(
+                rank=len(dims) if dims else None,
+                dims=dims,
+                dtype=self._dtype_kw(e, 2),
+                fill=fill if fill is not None else _NOFILL,
+            )
+        if name in CTOR_FILLED and e.args:
+            dims = self._shape_dims(e.args[0])
+            fill = CTOR_FILLED[name]
+            return SI(
+                rank=len(dims) if dims else None,
+                dims=dims,
+                dtype=self._dtype_kw(e, 1),
+                fill=fill if fill is not None else _NOFILL,
+            )
+        if name in CTOR_ARRAY and e.args:
+            return SI(
+                rank=self._literal_rank(e.args[0]),
+                dtype=self._dtype_kw(e, 1),
+            )
+        if name == "arange":
+            return SI(rank=1, dtype=self._dtype_kw(e, None))
+        if name in ("concatenate", "pad"):
+            if e.args:
+                inner = e.args[0]
+                if name == "pad":
+                    base = self.infer(inner, env)
+                    if isinstance(base, SI):
+                        return SI(rank=base.rank, dims=base.dims, dtype=base.dtype)
+                    return None
+                if isinstance(inner, (ast.List, ast.Tuple)):
+                    return _unify_si(
+                        [
+                            v if isinstance(v, SI) else None
+                            for v in (self.infer(el, env) for el in inner.elts)
+                        ]
+                    )
+            return None
+        if name == "stack" and e.args:
+            inner = e.args[0]
+            if isinstance(inner, (ast.List, ast.Tuple)) and inner.elts:
+                base = self.infer(inner.elts[0], env)
+                if isinstance(base, SI) and base.rank is not None:
+                    return SI(rank=base.rank + 1, dtype=base.dtype)
+            return None
+        if name == "broadcast_to" and len(e.args) > 1:
+            dims = self._shape_dims(e.args[1])
+            base = self.infer(e.args[0], env)
+            return SI(
+                rank=len(dims) if dims else None,
+                dims=dims,
+                dtype=base.dtype if isinstance(base, SI) else None,
+            )
+        return None
+
+    def infer_with_calls(self, e: ast.AST, env: Dict[str, object]) -> object:
+        """infer() plus one level of same-module call-return inference."""
+        if (
+            isinstance(e, ast.Call)
+            and isinstance(e.func, ast.Name)
+            and e.func.id in self.scan.functions
+        ):
+            return self._returns_of(e.func.id)
+        return self.infer(e, env)
+
+
+# --- the SC004 multiple-of-k prover ---------------------------------------
+
+
+class Prover:
+    """Best-effort 'is this expression a multiple of k' discharge over
+    the function's visible assignments plus module constants and one
+    level of same-module call returns."""
+
+    def __init__(self, scan: ModuleScan):
+        self.scan = scan
+        self._defs_cache: Dict[int, Dict[str, List[object]]] = {}
+        self._module_defs = self._collect(scan.tree.body)
+
+    def _collect(self, stmts: List[ast.stmt]) -> Dict[str, List[object]]:
+        defs: Dict[str, List[object]] = {}
+
+        def walk(body: List[ast.stmt]) -> None:
+            for s in body:
+                if isinstance(s, ast.Assign):
+                    for t in s.targets:
+                        if isinstance(t, ast.Name):
+                            defs.setdefault(t.id, []).append(s.value)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            paired = (
+                                isinstance(s.value, (ast.Tuple, ast.List))
+                                and len(s.value.elts) == len(t.elts)
+                            )
+                            for i, el in enumerate(t.elts):
+                                if not isinstance(el, ast.Name):
+                                    continue
+                                if paired:
+                                    defs.setdefault(el.id, []).append(
+                                        s.value.elts[i]
+                                    )
+                                elif isinstance(s.value, ast.Call):
+                                    defs.setdefault(el.id, []).append(
+                                        ("elt", s.value, i)
+                                    )
+                                # non-call unpack (e.g. `a, b, c = x.shape`):
+                                # runtime facts, out of the prover's reach —
+                                # leave the name undefined so it is trusted
+                elif isinstance(s, ast.AugAssign) and isinstance(
+                    s.target, ast.Name
+                ):
+                    defs.setdefault(s.target.id, []).append(
+                        ("aug", s.op, s.value)
+                    )
+                elif isinstance(s, (ast.If, ast.For, ast.While, ast.With, ast.Try)):
+                    for attr in ("body", "orelse", "finalbody"):
+                        walk(getattr(s, attr, []) or [])
+                    for h in getattr(s, "handlers", []):
+                        walk(h.body)
+
+        walk(stmts)
+        return defs
+
+    def _defs_for(self, fn: Optional[ast.FunctionDef]) -> Dict[str, List[object]]:
+        if fn is None:
+            return self._module_defs
+        key = id(fn)
+        if key not in self._defs_cache:
+            self._defs_cache[key] = self._collect(fn.body)
+        return self._defs_cache[key]
+
+    def prove(
+        self,
+        e: ast.AST,
+        k: int,
+        fn: Optional[ast.FunctionDef],
+        visited: Optional[Set[str]] = None,
+        depth: int = 0,
+    ) -> bool:
+        if depth > 12:
+            return False
+        visited = visited or set()
+        c = _const_int(e)
+        if c is not None:
+            return c % k == 0
+        if isinstance(e, ast.UnaryOp) and isinstance(e.op, (ast.USub, ast.UAdd)):
+            return self.prove(e.operand, k, fn, visited, depth + 1)
+        if isinstance(e, ast.BinOp):
+            if isinstance(e.op, ast.Mult):
+                return self.prove(e.left, k, fn, visited, depth + 1) or self.prove(
+                    e.right, k, fn, visited, depth + 1
+                )
+            if isinstance(e.op, (ast.Add, ast.Sub)):
+                return self.prove(e.left, k, fn, visited, depth + 1) and self.prove(
+                    e.right, k, fn, visited, depth + 1
+                )
+            if isinstance(e.op, ast.LShift):
+                sh = _const_int(e.right)
+                if sh is not None and (1 << sh) % k == 0:
+                    return True
+            return False
+        if isinstance(e, ast.Call):
+            fname = e.func.id if isinstance(e.func, ast.Name) else None
+            if fname in ("max", "min"):
+                return all(
+                    self.prove(a, k, fn, visited, depth + 1) for a in e.args
+                )
+            if fname in self.scan.functions and fname not in visited:
+                return self._prove_call(fname, None, k, visited, depth)
+            return False
+        if isinstance(e, ast.Name):
+            # scope the cycle guard per function: a caller's `bs` must
+            # not shadow a callee's `bs`
+            key = f"{id(fn)}:{e.id}"
+            if key in visited:
+                return False
+            defs = self._defs_for(fn)
+            cand = defs.get(e.id)
+            if cand is None and fn is not None:
+                cand = self._module_defs.get(e.id)
+            if not cand:
+                return False
+            visited = visited | {key}
+            plain_ok = True
+            saw_plain = False
+            for d in cand:
+                if isinstance(d, tuple) and d[0] == "aug":
+                    _, op, val = d
+                    if isinstance(op, ast.Mult):
+                        continue  # multiplying preserves multiples
+                    if isinstance(op, (ast.Add, ast.Sub)) and self.prove(
+                        val, k, fn, visited, depth + 1
+                    ):
+                        continue
+                    return False
+                elif isinstance(d, tuple) and d[0] == "elt":
+                    _, call, idx = d
+                    if not (
+                        isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and self._prove_call(
+                            call.func.id, idx, k, visited, depth
+                        )
+                    ):
+                        return False
+                    saw_plain = True
+                else:
+                    saw_plain = True
+                    if not self.prove(d, k, fn, visited, depth + 1):
+                        plain_ok = False
+            return saw_plain and plain_ok
+        return False
+
+    def _prove_call(
+        self, fname: str, idx: Optional[int], k: int, visited: Set[str], depth: int
+    ) -> bool:
+        fn = self.scan.functions.get(fname)
+        if fn is None or fname in visited or depth > 12:
+            return False
+        visited = visited | {fname}
+        rets: List[ast.AST] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                rets.append(node.value)
+        if not rets:
+            return False
+        for r in rets:
+            target: Optional[ast.AST] = r
+            if idx is not None:
+                if isinstance(r, ast.Tuple) and idx < len(r.elts):
+                    target = r.elts[idx]
+                else:
+                    return False
+            if not self.prove(target, k, fn, visited, depth + 1):
+                return False
+        return True
+
+
+def _has_round_math(e: ast.AST) -> bool:
+    for node in ast.walk(e):
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.FloorDiv, ast.Mod, ast.Mult, ast.LShift)
+        ):
+            return True
+    return False
+
+
+# --- per-module checker ---------------------------------------------------
+
+
+class Checker:
+    def __init__(self, scan: ModuleScan, registry: Registry):
+        self.scan = scan
+        self.registry = registry
+        self.inf = Inferencer(scan, registry)
+        self.prover = Prover(scan)
+        self.findings: List[Finding] = []
+
+    def _add(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(
+            Finding(
+                self.scan.path,
+                getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0),
+                code,
+                message,
+            )
+        )
+
+    def run(self) -> List[Finding]:
+        env: Dict[str, object] = {}
+        self._exec(self.scan.tree.body, env, None)
+        for fn in self._all_functions(self.scan.tree):
+            fenv: Dict[str, object] = {}
+            for p, sp in self.scan.func_contracts.get(fn.name, {}).items():
+                fenv[p] = _spec_si(sp)
+            self._exec(fn.body, fenv, fn)
+        for cls, keys in self.scan.wire_contracts.items():
+            self._check_wire(cls, keys)
+        return self.findings
+
+    def _all_functions(self, tree: ast.Module) -> List[ast.FunctionDef]:
+        return [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]
+
+    # -- statement walk ----------------------------------------------------
+
+    def _exec(
+        self,
+        stmts: List[ast.stmt],
+        env: Dict[str, object],
+        fn: Optional[ast.FunctionDef],
+    ) -> None:
+        for s in stmts:
+            if isinstance(s, ast.FunctionDef):
+                continue  # checked at top level with its own env
+            if isinstance(s, ast.Assign):
+                val = self.inf.infer_with_calls(s.value, env)
+                self.inf.bind(s.targets, val, env)
+                self._check_assign_comment(s, val, env, fn)
+            elif isinstance(s, ast.AnnAssign) and s.value is not None:
+                val = self.inf.infer_with_calls(s.value, env)
+                self.inf.bind([s.target], val, env)
+            elif isinstance(s, ast.AugAssign):
+                pass
+            self._check_stmt(s, env, fn)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if sub and not isinstance(s, (ast.FunctionDef, ast.ClassDef)):
+                    self._exec(sub, env, fn)
+            for h in getattr(s, "handlers", []):
+                self._exec(h.body, env, fn)
+
+    def _check_assign_comment(
+        self,
+        s: ast.Assign,
+        val: object,
+        env: Dict[str, object],
+        fn: Optional[ast.FunctionDef],
+    ) -> None:
+        """Canonical `# shape:` / `# tile:` trailing comments on an
+        assignment assert (and, for shape, re-declare) the target."""
+        if not (0 < s.lineno <= len(self.scan.lines)):
+            return
+        line = self.scan.lines[s.lineno - 1]
+        end = getattr(s, "end_lineno", s.lineno) or s.lineno
+        if "# shape:" not in line and "# tile:" not in line \
+                and 0 < end <= len(self.scan.lines):
+            line = self.scan.lines[end - 1]  # comment on the closing line
+        tm = _TILE_RE.search(line)
+        if tm:
+            k = int(tm.group(1))
+            if not self.prover.prove(s.value, k, fn):
+                self._add(
+                    s,
+                    "SC004",
+                    f"asserted `# tile: {k}` but the value is not provably "
+                    f"a multiple of {k} (hand-rolled round math the prover "
+                    f"can't discharge)",
+                )
+        if "# shape:" not in line:
+            return
+        sp = parse_comment_spec(line)
+        if sp is None or len(s.targets) != 1:
+            return
+        target = s.targets[0]
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Subscript) and isinstance(
+            target.slice, ast.Constant
+        ) and isinstance(target.slice.value, str):
+            name = target.slice.value  # t["pod_ip"] = ... style
+        else:
+            return
+        self.scan.n_annotations += 1
+        si = val if isinstance(val, SI) else None
+        if si is not None:
+            if si.rank is not None and si.rank != len(sp.dims):
+                self._add(
+                    s,
+                    "SC001",
+                    f"{name} declared {sp.render()} (rank {len(sp.dims)}) "
+                    f"but built with rank {si.rank}",
+                )
+            if si.dtype is not None and sp.dtype and si.dtype != sp.dtype:
+                self._add(
+                    s,
+                    "SC001",
+                    f"{name} declared dtype {sp.dtype} but built as {si.dtype}",
+                )
+            if (
+                sp.sentinel
+                and si.fill is not _NOFILL
+                and isinstance(si.fill, int)
+                and si.fill < 0
+                and si.fill not in sp.sentinel
+            ):
+                self._add(
+                    s,
+                    "SC003",
+                    f"{name} declared sentinel {list(sp.sentinel)} but "
+                    f"filled with {si.fill}",
+                )
+        env[name] = _spec_si(sp)
+
+    # -- expression checks -------------------------------------------------
+
+    def _check_stmt(
+        self,
+        s: ast.stmt,
+        env: Dict[str, object],
+        fn: Optional[ast.FunctionDef],
+    ) -> None:
+        names_in_stmt = {
+            n.id for n in ast.walk(s) if isinstance(n, ast.Name)
+        } | {
+            n.attr for n in ast.walk(s) if isinstance(n, ast.Attribute)
+        } | {
+            n.slice.value
+            for n in ast.walk(s)
+            if isinstance(n, ast.Subscript)
+            and isinstance(n.slice, ast.Constant)
+            and isinstance(n.slice.value, str)
+        }
+        own_exprs = self._own_exprs(s)
+        for node in own_exprs:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    self._check_call(sub, env, fn)
+                elif isinstance(sub, ast.Compare):
+                    self._check_compare(sub, env, names_in_stmt)
+                elif isinstance(sub, ast.BinOp):
+                    self._check_binop(sub, env, names_in_stmt)
+
+    def _own_exprs(self, s: ast.stmt) -> List[ast.AST]:
+        """Expressions belonging to THIS statement (not its nested
+        blocks, which _exec visits separately)."""
+        out: List[ast.AST] = []
+        for name, value in ast.iter_fields(s):
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.AST):
+                out.append(value)
+            elif isinstance(value, list):
+                out.extend(v for v in value if isinstance(v, ast.AST))
+        return out
+
+    def _masked_ref(self, e: ast.AST) -> Optional[Tuple[str, str]]:
+        """(field, mask) when `e` references a mask-declared field."""
+        name = None
+        if isinstance(e, ast.Name):
+            name = e.id
+        elif isinstance(e, ast.Attribute):
+            name = e.attr
+        elif isinstance(e, ast.Subscript) and isinstance(
+            e.slice, ast.Constant
+        ) and isinstance(e.slice.value, str):
+            name = e.slice.value
+        if name is not None and name in self.registry.masked:
+            return name, self.registry.masked[name]
+        return None
+
+    def _check_compare(
+        self, node: ast.Compare, env: Dict[str, object], stmt_names: Set[str]
+    ) -> None:
+        operands = [node.left, *node.comparators]
+        self._cross_sign(node, operands, env)
+        self._rank_broadcast(node, operands, env)
+        # SC003: a masked field compared without its validity mask in
+        # the same statement
+        for sub in ast.walk(node):
+            ref = self._masked_ref(sub)
+            if ref is not None and ref[1] not in stmt_names:
+                self._add(
+                    node,
+                    "SC003",
+                    f"{ref[0]} is only meaningful where {ref[1]} is True "
+                    f"(declared mask), but this comparison does not "
+                    f"consult {ref[1]} in the same statement",
+                )
+                break
+
+    def _check_binop(
+        self, node: ast.BinOp, env: Dict[str, object], stmt_names: Set[str]
+    ) -> None:
+        if isinstance(node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+            self._cross_sign(node, [node.left, node.right], env)
+        if isinstance(node.op, (ast.Add, ast.Sub, ast.Mult, ast.MatMult)):
+            lt = self.inf.infer(node.left, env)
+            rt = self.inf.infer(node.right, env)
+            if (
+                isinstance(lt, SI)
+                and isinstance(rt, SI)
+                and lt.dtype == "bool"
+                and rt.dtype == "bool"
+            ):
+                msg = (
+                    "matmul on two bool arrays stays bool (every nonzero "
+                    "sum collapses to True — counts are lost; astype an "
+                    "integer dtype first)"
+                    if isinstance(node.op, ast.MatMult)
+                    else "arithmetic on two bool arrays upcasts to int "
+                    "(use logical &/| or an explicit astype)"
+                )
+                self._add(node, "SC002", msg)
+        self._rank_broadcast(node, [node.left, node.right], env)
+
+    def _cross_sign(
+        self, node: ast.AST, operands: List[ast.AST], env: Dict[str, object]
+    ) -> None:
+        dtypes = []
+        for op in operands:
+            si = self.inf.infer(op, env)
+            dtypes.append(si.dtype if isinstance(si, SI) else None)
+        signed = [d for d in dtypes if d in SIGNED]
+        unsigned = [d for d in dtypes if d in UNSIGNED]
+        if signed and unsigned:
+            self._add(
+                node,
+                "SC002",
+                f"cross-signedness operation ({unsigned[0]} vs {signed[0]}) "
+                f"silently promotes to int64 — cast one side explicitly",
+            )
+
+    def _rank_broadcast(
+        self, node: ast.AST, operands: List[ast.AST], env: Dict[str, object]
+    ) -> None:
+        """SC001: two bare declared names of different rank broadcast
+        implicitly (a reshape/[None]-index marks intent and skips)."""
+        ranks = []
+        for op in operands:
+            if not isinstance(op, ast.Name):
+                return
+            si = self.inf.infer(op, env)
+            if not isinstance(si, SI) or si.rank is None:
+                return
+            ranks.append(si.rank)
+        if len(set(ranks)) > 1:
+            self._add(
+                node,
+                "SC001",
+                f"implicit rank-changing broadcast between declared arrays "
+                f"of rank {ranks[0]} and rank {ranks[1]} (insert an "
+                f"explicit [None]-index or reshape)",
+            )
+
+    def _check_call(
+        self,
+        node: ast.Call,
+        env: Dict[str, object],
+        fn: Optional[ast.FunctionDef],
+    ) -> None:
+        f = node.func
+        cname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if cname == "BlockSpec":
+            self._check_blockspec(node, fn)
+            return
+        # SC002: bare float literals in an array ctor without dtype
+        root = _attr_root(f)
+        if (
+            root in ARRAY_MODULES
+            and isinstance(f, ast.Attribute)
+            and f.attr in CTOR_ARRAY | {"full"}
+            and node.args
+        ):
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords) or (
+                f.attr in CTOR_ARRAY and len(node.args) > 1
+            )
+            lit = node.args[1] if f.attr == "full" and len(node.args) > 1 \
+                else node.args[0]
+            if not has_dtype and not (f.attr == "full" and len(node.args) > 2):
+                for sub in ast.walk(lit):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, float
+                    ):
+                        self._add(
+                            node,
+                            "SC002",
+                            "bare float literal in an array constructor "
+                            "without dtype= (poisons to float64 under "
+                            "x64; pin the dtype)",
+                        )
+                        break
+        # contract-class constructor / contract-function call
+        if cname in self.registry.class_contracts:
+            self._check_ctor(node, cname, env)
+        elif cname in self.registry.func_contracts and not isinstance(
+            f, ast.Attribute
+        ):
+            self._check_func_call(node, cname, env)
+
+    def _check_value_against(
+        self,
+        node: ast.AST,
+        what: str,
+        sp: Spec,
+        si: object,
+    ) -> None:
+        if not isinstance(si, SI):
+            return
+        if si.rank is not None and si.rank != len(sp.dims):
+            self._add(
+                node,
+                "SC001",
+                f"{what} declared {sp.render()} (rank {len(sp.dims)}) but "
+                f"built/passed with rank {si.rank}",
+            )
+            return
+        if si.dtype is not None and sp.dtype and si.dtype != sp.dtype:
+            self._add(
+                node,
+                "SC001",
+                f"{what} declared dtype {sp.dtype} but built/passed as "
+                f"{si.dtype}",
+            )
+        if si.dims is not None:
+            for want, got in zip(sp.dims, si.dims):
+                if (
+                    isinstance(want, int)
+                    and isinstance(got, int)
+                    and want != got
+                ):
+                    self._add(
+                        node,
+                        "SC001",
+                        f"{what} declared dim {want} but built with {got}",
+                    )
+        if (
+            sp.sentinel
+            and si.fill is not _NOFILL
+            and isinstance(si.fill, int)
+            and si.fill < 0
+            and si.fill not in sp.sentinel
+        ):
+            self._add(
+                node,
+                "SC003",
+                f"{what} declared sentinel {list(sp.sentinel)} but filled "
+                f"with {si.fill}",
+            )
+
+    def _check_ctor(
+        self, node: ast.Call, cname: str, env: Dict[str, object]
+    ) -> None:
+        fields = self.registry.class_contracts[cname]
+        for kw in node.keywords:
+            if kw.arg in fields:
+                si = self.inf.infer_with_calls(kw.value, env)
+                self._check_value_against(
+                    kw.value, f"{cname}.{kw.arg}", fields[kw.arg], si
+                )
+
+    def _check_func_call(
+        self, node: ast.Call, fname: str, env: Dict[str, object]
+    ) -> None:
+        specs = self.registry.func_contracts[fname]
+        fn = self.scan.functions.get(fname)
+        pos: List[str] = []
+        if fn is not None:
+            pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for i, a in enumerate(node.args):
+            if i < len(pos) and pos[i] in specs:
+                si = self.inf.infer_with_calls(a, env)
+                self._check_value_against(
+                    a, f"{fname}({pos[i]})", specs[pos[i]], si
+                )
+        for kw in node.keywords:
+            if kw.arg in specs:
+                si = self.inf.infer_with_calls(kw.value, env)
+                self._check_value_against(
+                    kw.value, f"{fname}({kw.arg})", specs[kw.arg], si
+                )
+
+    def _check_blockspec(
+        self, node: ast.Call, fn: Optional[ast.FunctionDef]
+    ) -> None:
+        """SC004: the LANE (last) dim of a pallas block shape must be a
+        provable multiple of 128.  Full-axis symbolic dims with no
+        visible round math are trusted (Mosaic pads whole axes)."""
+        if not node.args or not isinstance(node.args[0], (ast.Tuple, ast.List)):
+            return
+        dims = node.args[0].elts
+        if not dims:
+            return
+        last = dims[-1]
+        c = _const_int(last)
+        if c is not None:
+            if c % LANE != 0 and c != 1:
+                self._add(
+                    last,
+                    "SC004",
+                    f"BlockSpec lane dim {c} is not a multiple of the "
+                    f"{LANE}-lane tile",
+                )
+            return
+        if isinstance(last, ast.Name):
+            defs = self.prover._defs_for(fn)
+            cand = defs.get(last.id) or self.prover._module_defs.get(last.id)
+            if not cand:
+                return  # parameter / unknown: out of the prover's reach
+            interesting = [
+                d
+                for d in cand
+                if isinstance(d, tuple)
+                or _has_round_math(d)
+                or isinstance(d, ast.Call)
+            ]
+            if not interesting:
+                return  # opaque definition with no round math: trusted
+        elif not (_has_round_math(last) or isinstance(last, ast.Call)):
+            return
+        if not self.prover.prove(last, LANE, fn):
+            self._add(
+                last,
+                "SC004",
+                f"BlockSpec lane dim `{ast.unparse(last)}` cannot be "
+                f"proven a multiple of the {LANE}-lane tile (hand-rolled "
+                f"round math the prover can't discharge)",
+            )
+
+    # -- wire contracts ----------------------------------------------------
+
+    def _check_wire(self, cls: str, keys: Dict[str, bool]) -> None:
+        """SC001: the emit side of a WIRE-declared class must match the
+        contract — required keys emitted unconditionally, optional keys
+        only behind a condition, no undeclared keys."""
+        node = self.scan.classes.get(cls)
+        if node is None:
+            return
+        for meth in node.body:
+            if not isinstance(meth, ast.FunctionDef) or meth.name not in (
+                "to_dict",
+                "to_json",
+            ):
+                continue
+            base: Set[str] = set()
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Dict):
+                    ks = {
+                        k.value
+                        for k in sub.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                    if len(ks) > len(base):
+                        base = ks
+            conditional: Set[str] = set()
+            unconditional: Set[str] = set(base)
+
+            def scan_assigns(stmts: List[ast.stmt], in_if: bool) -> None:
+                for s in stmts:
+                    if isinstance(s, ast.Assign):
+                        for t in s.targets:
+                            if (
+                                isinstance(t, ast.Subscript)
+                                and isinstance(t.slice, ast.Constant)
+                                and isinstance(t.slice.value, str)
+                            ):
+                                (conditional if in_if else unconditional).add(
+                                    t.slice.value
+                                )
+                    for attr in ("body", "orelse"):
+                        sub = getattr(s, attr, None)
+                        if sub:
+                            scan_assigns(
+                                sub, in_if or isinstance(s, (ast.If, ast.While))
+                            )
+
+            scan_assigns(meth.body, False)
+            for key in sorted(unconditional | conditional):
+                if key not in keys:
+                    self._add(
+                        meth,
+                        "SC001",
+                        f"{cls}.{meth.name} emits wire key {key!r} with no "
+                        f"WIRE contract entry",
+                    )
+            for key, optional in keys.items():
+                if not optional and key not in unconditional:
+                    self._add(
+                        meth,
+                        "SC001",
+                        f"{cls}.{meth.name}: required wire key {key!r} is "
+                        f"not emitted unconditionally (compat rule: the "
+                        f"reference shape is frozen)",
+                    )
+                elif optional and key in unconditional:
+                    self._add(
+                        meth,
+                        "SC001",
+                        f"{cls}.{meth.name}: optional wire key {key!r} is "
+                        f"emitted unconditionally (compat rule: extensions "
+                        f"must be omitted when unset)",
+                    )
+
+
+# --- driver ---------------------------------------------------------------
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f)
+                    for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
+    files = iter_py_files(paths)
+    scans: List[ModuleScan] = []
+    findings: List[Finding] = []
+    registry = Registry()
+    for path in files:
+        with open(path, "r") as f:
+            source = f.read()
+        scan = scan_module(path, source)
+        if scan is None:
+            findings.append(Finding(path, 0, 0, "SC000", "syntax error"))
+            continue
+        scans.append(scan)
+        registry.absorb(scan)
+    for scan in scans:
+        raw = Checker(scan, registry).run()
+        # suppression + dedup (same convention as jaxlint)
+        seen: Set[Tuple[str, int, int, str, str]] = set()
+        for f in raw:
+            key = (f.path, f.line, f.col, f.code, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            line_src = (
+                scan.lines[f.line - 1] if 0 < f.line <= len(scan.lines) else ""
+            )
+            m = _IGNORE_RE.search(line_src)
+            if m:
+                codes = m.group(1)
+                if codes is None or f.code in {
+                    c.strip() for c in codes.split(",")
+                }:
+                    continue
+            findings.append(f)
+    stats = {
+        "contracts": sum(s.n_annotations for s in scans),
+        "files": len(files),
+    }
+    return (
+        sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)),
+        stats,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["cyclonus_tpu/engine"],
+        help="files/directories to lint (default: cyclonus_tpu/engine)",
+    )
+    args = ap.parse_args(argv)
+    findings, stats = lint_paths(args.paths)
+    for f in findings:
+        print(f.render())
+    print(
+        f"shapelint: {len(findings)} finding(s), {stats['contracts']} "
+        f"contract annotation(s) in {stats['files']} file(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
