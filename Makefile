@@ -22,17 +22,27 @@ lint: shapelint
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
-	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe
+	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
+	  cyclonus_tpu/perfobs
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
-	  cyclonus_tpu/worker/model.py
+	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs
+
+# the perf observatory's regression sentinel (docs/DESIGN.md "Perf
+# observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
+# gate the latest run against min-of-N baselines.  Exit 1 = engine
+# regression (phase named in the delta report), 2 = infra flake
+# (backend_init/tunnel — retried by tools/tunnel_wait.py, not an
+# engine problem).  Pure host-side parsing: works with a dead tunnel.
+perf-gate:
+	python -m cyclonus_tpu perf gate
 
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
-# syntax-compile everything, lint the hot paths, then run the suite on a
-# CPU 8-device mesh
-check: vet lint
+# syntax-compile everything, lint the hot paths, gate the perf history,
+# then run the suite on a CPU 8-device mesh
+check: vet lint perf-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -68,4 +78,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz race bench fmt vet lint shapelint cyclonus docker
+.PHONY: test check conformance fuzz race bench fmt vet lint shapelint perf-gate cyclonus docker

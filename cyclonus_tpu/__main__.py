@@ -1,4 +1,9 @@
+import sys
+
 from .cli import main
 
 if __name__ == "__main__":
-    main()
+    # the return value IS the process exit code — the perf gate (and
+    # any CI caller of `python -m cyclonus_tpu`) depends on nonzero
+    # propagating, exactly like the `cyclonus-tpu` console script
+    sys.exit(main())

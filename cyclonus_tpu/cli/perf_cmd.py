@@ -1,0 +1,255 @@
+"""`cyclonus-tpu perf` — the perf observatory CLI (docs/DESIGN.md
+"Perf observatory").
+
+    perf gate    ingest the round artifacts, gate the latest run
+                 against min-of-N baselines; exit 0 pass / 1 engine
+                 regression / 2 infra flake, with a delta report that
+                 names the offending phase (`make perf-gate`)
+    perf report  markdown/JSON trend report, or the Prometheus
+                 exposition with the cyclonus_tpu_perf_* gauges
+                 published (optionally served via --metrics-port on
+                 the existing telemetry server)
+
+Both modes are pure host-side file parsing: they must work on a
+machine whose TPU tunnel is dead, because that is the situation they
+diagnose.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _add_common(p) -> None:
+    p.add_argument(
+        "--dir",
+        default=".",
+        metavar="DIR",
+        help="directory holding the round artifacts (default: .)",
+    )
+    p.add_argument(
+        "--bench-glob",
+        default="BENCH_r*.json",
+        metavar="GLOB",
+        help="bench artifact glob under --dir (default: BENCH_r*.json)",
+    )
+    p.add_argument(
+        "--multichip-glob",
+        default="MULTICHIP_r*.json",
+        metavar="GLOB",
+        help="multichip artifact glob (default: MULTICHIP_r*.json)",
+    )
+    p.add_argument(
+        "--run",
+        action="append",
+        default=[],
+        metavar="PATH",
+        help="extra bench artifact(s) to ingest after the glob (e.g. a "
+        "tools/tunnel_wait.py round file); the last one becomes the "
+        "gate candidate",
+    )
+
+
+def setup_perf(sub) -> None:
+    perf = sub.add_parser(
+        "perf",
+        help="perf observatory: bench-history ledger, regression gate, "
+        "trend report",
+    )
+    modes = perf.add_subparsers(dest="perf_mode", required=True)
+
+    g = modes.add_parser(
+        "gate",
+        help="noise-aware regression gate over the bench history "
+        "(exit 0 pass, 1 engine regression, 2 infra flake)",
+    )
+    _add_common(g)
+    g.add_argument(
+        "--baseline-n",
+        type=int,
+        default=3,
+        help="how many prior healthy runs form the min-of-N baseline",
+    )
+    g.add_argument(
+        "--rate-tol",
+        type=float,
+        default=0.30,
+        help="allowed cells/s drop vs best-of-N (fraction, default 0.30 "
+        "— the tunneled-chip timing noise envelope)",
+    )
+    g.add_argument(
+        "--warmup-tol",
+        type=float,
+        default=0.50,
+        help="allowed warmup_s growth vs min-of-N (fraction)",
+    )
+    g.add_argument(
+        "--warmup-slack",
+        type=float,
+        default=2.0,
+        metavar="S",
+        help="absolute warmup slack in seconds on top of the bound",
+    )
+    g.add_argument(
+        "--phase-tol",
+        type=float,
+        default=0.50,
+        help="allowed per-phase growth vs min-of-N (fraction)",
+    )
+    g.add_argument(
+        "--phase-slack",
+        type=float,
+        default=2.0,
+        metavar="S",
+        help="absolute per-phase slack in seconds (keeps near-zero "
+        "phases from gating on noise)",
+    )
+    g.add_argument(
+        "--min-scaling-efficiency",
+        type=float,
+        default=0.5,
+        help="multichip gate: per-chip rate at max devices must be at "
+        "least this fraction of the SAME workload's 1-device rate "
+        "(real meshes only; virtual CPU-mesh rates are reported, "
+        "never gated)",
+    )
+    g.add_argument(
+        "--allow-infra",
+        action="store_true",
+        help="exit 0 on an infra flake (backend_init/tunnel) instead "
+        "of 2 — for CI lanes that retry infra separately",
+    )
+    g.add_argument(
+        "--json",
+        action="store_true",
+        help="print the gate result as JSON instead of the text report",
+    )
+    g.set_defaults(func=_run_gate)
+
+    r = modes.add_parser(
+        "report",
+        help="trend report over the ledger (markdown/json/prometheus)",
+    )
+    _add_common(r)
+    r.add_argument(
+        "--format",
+        default="markdown",
+        choices=["markdown", "json", "prometheus"],
+        help="markdown = human trend table; json = the full ledger + "
+        "gate; prometheus = text exposition with the "
+        "cyclonus_tpu_perf_* gauges published",
+    )
+    r.add_argument(
+        "--out",
+        default="",
+        metavar="PATH",
+        help="write the report to PATH instead of stdout",
+    )
+    r.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="publish the gauges and serve them on the telemetry "
+        "metrics server (0 = ephemeral port; serves until "
+        "interrupted, or for --serve-seconds)",
+    )
+    r.add_argument(
+        "--serve-seconds",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="with --metrics-port: serve for this long then exit "
+        "(0 = until interrupted)",
+    )
+    r.set_defaults(func=_run_report)
+
+
+def _load(args):
+    from ..perfobs import load_ledger
+
+    return load_ledger(
+        args.dir,
+        bench_glob=args.bench_glob,
+        multichip_glob=args.multichip_glob,
+        extra_bench=args.run,
+    )
+
+
+def _candidate(args, ledger):
+    """--run promises "the last one becomes the gate candidate" —
+    resolve it by SOURCE PATH, because ledger order is chronological
+    (round number, then run id), not argv order.  None = let the gate
+    pick the latest run."""
+    if not args.run:
+        return None
+    return next(
+        (r for r in ledger.runs if r.source == args.run[-1]), None
+    )
+
+
+def _run_gate(args) -> int:
+    import json
+
+    from ..perfobs import gate
+
+    ledger = _load(args)
+    result = gate(
+        ledger,
+        candidate=_candidate(args, ledger),
+        baseline_n=args.baseline_n,
+        rate_tol=args.rate_tol,
+        warmup_tol=args.warmup_tol,
+        warmup_slack_s=args.warmup_slack,
+        phase_tol=args.phase_tol,
+        phase_slack_s=args.phase_slack,
+        min_scaling_efficiency=args.min_scaling_efficiency,
+    )
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        print(result.report())
+    code = result.exit_code
+    if code == 2 and args.allow_infra:
+        print("(--allow-infra: infra flake tolerated)", file=sys.stderr)
+        return 0
+    return code
+
+
+def _run_report(args) -> int:
+    import time
+
+    from ..perfobs import gate
+    from ..perfobs import report as perf_report
+
+    ledger = _load(args)
+    result = gate(ledger, candidate=_candidate(args, ledger))
+    text = perf_report.render(ledger, args.format, result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"perf report: wrote {args.out}")
+    else:
+        print(text, end="")
+    if args.metrics_port is not None:
+        from ..telemetry.server import MetricsPortBusy, start_metrics_server
+
+        perf_report.publish(ledger, result)
+        try:
+            srv = start_metrics_server(args.metrics_port)
+        except MetricsPortBusy as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"perf report: serving cyclonus_tpu_perf_* on {srv.url}/metrics",
+            file=sys.stderr,
+        )
+        try:
+            if args.serve_seconds > 0:
+                time.sleep(args.serve_seconds)
+            else:
+                while True:
+                    time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return 0

@@ -27,11 +27,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from .analyze import setup_analyze
     from .generate import setup_generate
+    from .perf_cmd import setup_perf
     from .probe_cmd import setup_probe
     from .recipes_cmd import setup_recipes
 
     setup_analyze(sub)
     setup_generate(sub)
+    setup_perf(sub)
     setup_probe(sub)
     setup_recipes(sub)
 

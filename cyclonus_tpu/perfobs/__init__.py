@@ -1,0 +1,51 @@
+"""Perf observatory: the longitudinal side of the telemetry subsystem.
+
+Rounds 1-5 left the perf trajectory (6.6B -> 132.7B cells/s, warmup
+65s -> 7.2s) in write-only BENCH_r0*.json blobs, and the two rounds that
+failed (r03/r04) failed on TPU backend/tunnel init — indistinguishable,
+to any tool, from an engine regression.  This package turns those blobs
+into a queryable history with gates:
+
+  schema.py    one normalized run record (PerfRun): run id, per-phase
+               wall-clock, warmup breakdown, cells/s, cells/s-per-chip,
+               telemetry counters, and a failure_class
+               (backend_init | tunnel | watchdog_stall | engine | ok)
+  ledger.py    ingests BENCH_r*.json / MULTICHIP_r*.json (and bare
+               bench JSON lines from tools/tunnel_wait.py artifacts)
+               into a Ledger, classifying every failure from the
+               evidence the artifact carries — truncated files and
+               parsed-null rc=124 wrappers included
+  sentinel.py  the noise-aware regression gate (`cyclonus-tpu perf
+               gate`, `make perf-gate`): min-of-N baselines over prior
+               healthy runs, per-phase bounds, hard gates on
+               cells_per_sec / warmup_s / multichip scaling efficiency;
+               infra flakes (backend_init/tunnel) gate SEPARATELY from
+               engine regressions (distinct exit code), so a dead
+               tunnel can never read as a kernel regression again
+  report.py    markdown/JSON trend report (`cyclonus-tpu perf report`)
+               and the cyclonus_tpu_perf_* Prometheus gauges published
+               through the existing telemetry registry/metrics server
+
+Everything here is host-side stdlib: no jax import, no device contact —
+the gate must run on a machine whose TPU tunnel is dead, because that is
+exactly the situation it exists to diagnose.
+"""
+
+from __future__ import annotations
+
+from .ledger import Ledger, classify, ingest_bench, ingest_multichip, load_ledger
+from .schema import FAILURE_CLASSES, INFRA_CLASSES, PerfRun
+from .sentinel import GateResult, gate
+
+__all__ = [
+    "FAILURE_CLASSES",
+    "GateResult",
+    "INFRA_CLASSES",
+    "Ledger",
+    "PerfRun",
+    "classify",
+    "gate",
+    "ingest_bench",
+    "ingest_multichip",
+    "load_ledger",
+]

@@ -1,0 +1,372 @@
+"""Ingest perf artifacts into the ledger, classifying every failure.
+
+Three artifact shapes exist in the wild, and all of them must ingest
+UNCHANGED (the five BENCH_r0*.json / MULTICHIP_r0*.json blobs in the
+repo root are the acceptance fixtures):
+
+  * the driver wrapper: {"n", "cmd", "rc", "tail", "parsed"} — parsed
+    is the bench's final JSON line, or null when the run died without
+    one (r03: rc=124, only the backend warning on stdout);
+  * a bare bench JSON line ({"metric", "value", ..., "detail"}), as
+    written by tools/tunnel_wait.py round artifacts (plus bench_rc/at);
+  * the MULTICHIP dryrun wrapper: {"n_devices", "rc", "ok", "tail"}.
+
+Classification reads the EVIDENCE, not just the rc: an explicit
+failure_class in the JSON (new bench runs) wins; otherwise the error
+text and stdout tail are matched against the known cold-start
+signatures, and an rc=124 hang that never printed anything past backend
+discovery is attributed to the tunnel — the one component that hangs
+silently — not to the engine.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional
+
+from .schema import (
+    FAILURE_CLASSES,
+    PerfRun,
+    flatten_metric_samples,
+    phase_map,
+)
+
+# evidence -> class, checked in order; first match wins.  The tunnel
+# signatures run before the backend ones because r04's message names
+# both ("backend init did not complete ... TPU tunnel dead"): a join
+# timeout means the tunnel never answered, which is a harder claim than
+# "the backend misbehaved".
+_TUNNEL_RE = re.compile(
+    r"tunnel (?:is )?dead|tunnel dead|chip held by another process"
+    r"|did not complete within BENCH_INIT_DEADLINE"
+    # tunnel_wait's outer backstop firing means the bench's own
+    # watchdogs never printed — a pre-import hang, i.e. the tunnel
+    r"|exceeded the .*subprocess bound",
+    re.IGNORECASE,
+)
+_BACKEND_RE = re.compile(
+    r"backend init failed|backend setup/compile error"
+    r"|TPU backend setup|UNAVAILABLE: TPU|libtpu version mismatch",
+    re.IGNORECASE,
+)
+_WATCHDOG_RE = re.compile(r"watchdog|stalled \d+s in phase", re.IGNORECASE)
+
+
+def classify(
+    parsed: Optional[Dict[str, Any]],
+    rc: Optional[int] = None,
+    tail: str = "",
+) -> str:
+    """Map one artifact's evidence to a failure class."""
+    parsed = parsed or {}
+    explicit = parsed.get("failure_class")
+    if explicit in FAILURE_CLASSES:
+        return explicit
+    error = str(parsed.get("error") or "")
+    if not error and parsed.get("value", 0) and "value" in parsed:
+        return "ok"
+    evidence = error + "\n" + (tail or "")
+    if _WATCHDOG_RE.search(error):
+        return "watchdog_stall"
+    if _TUNNEL_RE.search(evidence):
+        return "tunnel"
+    if _BACKEND_RE.search(evidence):
+        return "backend_init"
+    if "value" not in parsed and rc == 124:
+        # killed by the driver without ever printing a bench JSON line
+        # past backend discovery: engine failures crash loudly
+        # (traceback, error JSON); only a wedged tunnel hangs silently
+        # (rounds 3/4)
+        return "tunnel"
+    return "engine"
+
+
+# canonical phase names for the named detail.*_s timings — these are
+# the precise (min-of-N) measurements; phase_history_s adds the rest
+_NAMED_PHASES = (
+    ("build_s", "matcher_build"),
+    ("encode_s", "encode"),
+    ("backend_init_s", "backend_init_join"),
+    ("warmup_s", "warmup"),
+    ("eval_s", "eval"),
+)
+
+
+def _collapse(phases: Dict[str, float]) -> Dict[str, float]:
+    """Dynamic phase names ("compiled_parity:2048x300:int8",
+    "mesh_scaling:4dev") collapse to their family so baselines across
+    runs compare like with like."""
+    out: Dict[str, float] = {}
+    for name, seconds in phases.items():
+        family = name.split(":", 1)[0]
+        out[family] = out.get(family, 0.0) + seconds
+    return out
+
+
+def _evidence_line(tail: str) -> Optional[str]:
+    """The line of the stdout tail that carries the failure signature
+    (falling back to the last non-empty line) — what the report quotes
+    as the run's error."""
+    lines = [l.strip() for l in (tail or "").splitlines() if l.strip()]
+    for line in reversed(lines):
+        if (
+            _TUNNEL_RE.search(line)
+            or _BACKEND_RE.search(line)
+            or _WATCHDOG_RE.search(line)
+        ):
+            return line
+    return lines[-1] if lines else None
+
+
+def _run_id_for(path: str, n: Optional[int], kind: str) -> str:
+    if n is not None and kind == "bench":
+        return f"r{n:02d}"
+    stem = os.path.splitext(os.path.basename(path))[0]
+    return stem.lower()
+
+
+def _bench_run_from_parsed(
+    run: PerfRun, parsed: Dict[str, Any]
+) -> PerfRun:
+    """Fill a PerfRun from a bench JSON line (success or error)."""
+    detail = parsed.get("detail") or {}
+    run.metric = parsed.get("metric")
+    run.error = parsed.get("error")
+    try:
+        run.cells_per_sec = float(parsed.get("value") or 0.0)
+    except (TypeError, ValueError):
+        run.cells_per_sec = 0.0
+    phases = _collapse(phase_map(detail.get("phase_history_s")))
+    for key, name in _NAMED_PHASES:
+        if isinstance(detail.get(key), (int, float)):
+            phases[name] = float(detail[key])
+    run.phases = phases
+    if isinstance(detail.get("warmup_s"), (int, float)):
+        run.warmup_s = float(detail["warmup_s"])
+    run.warmup_phases = {
+        k: float(v)
+        for k, v in (detail.get("warmup_phases") or {}).items()
+        if isinstance(v, (int, float))
+    }
+    tel = detail.get("telemetry") or {}
+    run.telemetry_counters = flatten_metric_samples(tel.get("metrics") or {})
+    cold = detail.get("cold_start") or detail.get("retries") or {}
+    if isinstance(cold, dict):
+        run.retries = dict(cold)
+    mesh = detail.get("mesh_scaling") or {}
+    rows = [
+        r
+        for r in (mesh.get("rows") or [])
+        if isinstance(r, dict)
+        and isinstance(r.get("cells_per_sec_per_chip"), (int, float))
+    ]
+    if rows:
+        # the stable field the scaling gate reads: the best per-chip
+        # rate at the HIGHEST device count the run exercised
+        n_dev = max(int(r.get("devices", 1)) for r in rows)
+        best = max(
+            float(r["cells_per_sec_per_chip"])
+            for r in rows
+            if int(r.get("devices", 1)) == n_dev
+        )
+        run.cells_per_sec_per_chip = best
+        run.n_devices = n_dev
+        run.virtual_mesh = bool(mesh.get("virtual", True))
+        # efficiency needs SAME-workload endpoints: a 1-device row of
+        # this very block is the only valid denominator (dividing by
+        # the headline single-chip rate would compare different
+        # problem sizes)
+        one_dev = [
+            float(r["cells_per_sec"])
+            for r in rows
+            if int(r.get("devices", 1)) == 1
+            and isinstance(r.get("cells_per_sec"), (int, float))
+        ]
+        if one_dev and n_dev > 1:
+            run.scaling_efficiency = round(best / max(one_dev), 4)
+    return run
+
+
+def ingest_bench(path: str, run_id: Optional[str] = None) -> PerfRun:
+    """One BENCH artifact (wrapper or bare line) -> PerfRun.  Never
+    raises on malformed content: a truncated file becomes a failed run
+    whose error records the parse failure (the r03 lesson — a bench
+    that can eat the scoreboard is itself a defect applies doubly to
+    the tool reading the scoreboard)."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        raw = ""
+        doc: Optional[Dict[str, Any]] = None
+        parse_error = f"{type(e).__name__}: {e}"
+    else:
+        try:
+            doc = json.loads(raw)
+            parse_error = None
+        except json.JSONDecodeError as e:
+            doc = None
+            parse_error = f"unparseable JSON: {e}"
+
+    if doc is None:
+        run = PerfRun(
+            run_id=run_id or _run_id_for(path, None, "bench"),
+            kind="bench",
+            source=path,
+            failure_class=classify(None, None, raw),
+            ok=False,
+            error=parse_error,
+        )
+        return run
+
+    if "parsed" in doc or "tail" in doc:  # driver wrapper
+        n = doc.get("n") if isinstance(doc.get("n"), int) else None
+        rc = doc.get("rc") if isinstance(doc.get("rc"), int) else None
+        parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else None
+        tail = str(doc.get("tail") or "")
+        fc = classify(parsed, rc, tail)
+        run = PerfRun(
+            run_id=run_id or _run_id_for(path, n, "bench"),
+            kind="bench",
+            source=path,
+            failure_class=fc,
+            ok=fc == "ok",
+            n=n,
+            rc=rc,
+        )
+        if parsed is not None:
+            _bench_run_from_parsed(run, parsed)
+        if run.error is None and fc != "ok":
+            run.error = _evidence_line(tail)
+        return run
+
+    # bare bench JSON line (tunnel_wait artifact or a raw bench capture)
+    rc = doc.get("bench_rc") if isinstance(doc.get("bench_rc"), int) else None
+    fc = classify(doc, rc, "")
+    run = PerfRun(
+        run_id=run_id or _run_id_for(path, None, "bench"),
+        kind="bench",
+        source=path,
+        failure_class=fc,
+        ok=fc == "ok",
+        rc=rc,
+    )
+    return _bench_run_from_parsed(run, doc)
+
+
+def _last_json_line(text: str) -> Optional[Dict[str, Any]]:
+    for line in reversed([l for l in text.splitlines() if l.startswith("{")]):
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+def ingest_multichip(path: str, run_id: Optional[str] = None) -> PerfRun:
+    """One MULTICHIP dryrun wrapper -> PerfRun.  New dryruns print a
+    JSON line with cells_per_sec_per_chip into the tail; old ones carry
+    only the human OK line, which still classifies."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+        doc = json.loads(raw)
+    except (OSError, json.JSONDecodeError) as e:
+        return PerfRun(
+            run_id=run_id or _run_id_for(path, None, "multichip"),
+            kind="multichip",
+            source=path,
+            failure_class="engine",
+            ok=False,
+            error=f"unparseable artifact: {e}",
+        )
+    rc = doc.get("rc") if isinstance(doc.get("rc"), int) else None
+    tail = str(doc.get("tail") or "")
+    ok = bool(doc.get("ok"))
+    fc = "ok" if ok else classify(None, rc, tail)
+    run = PerfRun(
+        run_id=run_id or _run_id_for(path, None, "multichip"),
+        kind="multichip",
+        source=path,
+        failure_class=fc,
+        ok=ok,
+        rc=rc,
+        n_devices=doc.get("n_devices")
+        if isinstance(doc.get("n_devices"), int)
+        else None,
+    )
+    line = _last_json_line(tail)
+    if line and isinstance(
+        line.get("cells_per_sec_per_chip"), (int, float)
+    ):
+        run.cells_per_sec_per_chip = float(line["cells_per_sec_per_chip"])
+        run.cells_per_sec = float(line.get("cells_per_sec") or 0.0)
+        run.virtual_mesh = bool(line.get("virtual", True))
+        if isinstance(line.get("n_devices"), int):
+            run.n_devices = line["n_devices"]
+    if not ok and run.error is None:
+        run.error = _evidence_line(tail)
+    return run
+
+
+class Ledger:
+    """The ordered run history the sentinel and report operate on."""
+
+    def __init__(self, runs: Iterable[PerfRun] = ()):
+        self.runs: List[PerfRun] = sorted(runs, key=PerfRun.sort_key)
+
+    def add(self, run: PerfRun) -> None:
+        self.runs.append(run)
+        self.runs.sort(key=PerfRun.sort_key)
+
+    def bench_runs(self) -> List[PerfRun]:
+        return [r for r in self.runs if r.kind == "bench"]
+
+    def multichip_runs(self) -> List[PerfRun]:
+        return [r for r in self.runs if r.kind == "multichip"]
+
+    def ok_bench_runs(self) -> List[PerfRun]:
+        return [r for r in self.bench_runs() if r.failure_class == "ok"]
+
+    def latest_bench(self) -> Optional[PerfRun]:
+        runs = self.bench_runs()
+        return runs[-1] if runs else None
+
+    def latest_multichip(self) -> Optional[PerfRun]:
+        runs = self.multichip_runs()
+        return runs[-1] if runs else None
+
+    def counts_by_class(self) -> Dict[str, int]:
+        out = {c: 0 for c in FAILURE_CLASSES}
+        for r in self.runs:
+            out[r.failure_class] += 1
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"runs": [r.to_dict() for r in self.runs]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Ledger":
+        return cls(PerfRun.from_dict(r) for r in d.get("runs", []))
+
+
+def load_ledger(
+    root: str = ".",
+    bench_glob: str = "BENCH_r*.json",
+    multichip_glob: str = "MULTICHIP_r*.json",
+    extra_bench: Iterable[str] = (),
+) -> Ledger:
+    """Glob the round artifacts under `root` into a Ledger."""
+    ledger = Ledger()
+    for path in sorted(_glob.glob(os.path.join(root, bench_glob))):
+        ledger.add(ingest_bench(path))
+    for path in sorted(_glob.glob(os.path.join(root, multichip_glob))):
+        ledger.add(ingest_multichip(path))
+    for path in extra_bench:
+        ledger.add(ingest_bench(path))
+    return ledger

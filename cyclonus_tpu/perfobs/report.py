@@ -1,0 +1,183 @@
+"""Trend report + Prometheus publication (`cyclonus-tpu perf report`).
+
+The markdown report is the human face of the ledger: one row per run
+with rate / warmup / failure class, the per-chip scaling evidence, and
+the cold-start forensics for every infra flake.  `publish()` mirrors
+the same numbers into `cyclonus_tpu_perf_*` gauges on the process-wide
+telemetry registry, so any process already serving `--metrics-port`
+(probe, generate, worker — telemetry/server.py) exposes the perf
+history to a scraper next to the live engine metrics.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from ..telemetry.metrics import REGISTRY
+from .ledger import Ledger
+from .schema import FAILURE_CLASSES, INFRA_CLASSES
+from .sentinel import GateResult
+
+# --- the cyclonus_tpu_perf_* instruments --------------------------------
+# Declared at import like telemetry/instruments.py, so a scrape of a
+# fresh process already shows the schema; per-run series appear when
+# publish() runs.
+
+PERF_CELLS_PER_SEC = REGISTRY.gauge(
+    "cyclonus_tpu_perf_cells_per_sec",
+    "Ledger: headline synchronous rate per benchmark run.",
+    labelnames=("run",),
+)
+PERF_WARMUP_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_perf_warmup_seconds",
+    "Ledger: warmup wall-clock per benchmark run.",
+    labelnames=("run",),
+)
+PERF_PHASE_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_perf_phase_seconds",
+    "Ledger: normalized per-phase wall-clock per benchmark run.",
+    labelnames=("run", "phase"),
+)
+PERF_CELLS_PER_SEC_PER_CHIP = REGISTRY.gauge(
+    "cyclonus_tpu_perf_cells_per_sec_per_chip",
+    "Ledger: per-chip rate of runs that recorded one (virtual=1 marks "
+    "CPU-mesh rates, which are shape evidence, not speedup).",
+    labelnames=("run", "virtual"),
+)
+PERF_RUNS = REGISTRY.gauge(
+    "cyclonus_tpu_perf_runs",
+    "Ledger: ingested runs by failure class.",
+    labelnames=("failure_class",),
+)
+PERF_BEST_CELLS_PER_SEC = REGISTRY.gauge(
+    "cyclonus_tpu_perf_best_cells_per_sec",
+    "Ledger: best healthy synchronous rate across the history.",
+)
+PERF_GATE_STATUS = REGISTRY.gauge(
+    "cyclonus_tpu_perf_gate_status",
+    "Last regression-gate outcome: 0 pass/no-data, 1 engine "
+    "regression, 2 infra flake.",
+)
+
+
+def publish(ledger: Ledger, result: Optional[GateResult] = None) -> None:
+    """Mirror the ledger (and optionally a gate outcome) into the
+    cyclonus_tpu_perf_* gauges."""
+    best = 0.0
+    for run in ledger.bench_runs():
+        PERF_CELLS_PER_SEC.set(run.cells_per_sec, run=run.run_id)
+        if run.warmup_s is not None:
+            PERF_WARMUP_SECONDS.set(run.warmup_s, run=run.run_id)
+        for phase, seconds in run.phases.items():
+            PERF_PHASE_SECONDS.set(seconds, run=run.run_id, phase=phase)
+        if run.failure_class == "ok":
+            best = max(best, run.cells_per_sec)
+    for run in ledger.runs:
+        if run.cells_per_sec_per_chip is not None:
+            PERF_CELLS_PER_SEC_PER_CHIP.set(
+                run.cells_per_sec_per_chip,
+                run=run.run_id,
+                virtual="1" if run.virtual_mesh else "0",
+            )
+    for cls, count in ledger.counts_by_class().items():
+        PERF_RUNS.set(count, failure_class=cls)
+    PERF_BEST_CELLS_PER_SEC.set(best)
+    if result is not None:
+        PERF_GATE_STATUS.set(float(result.exit_code))
+
+
+def trend(ledger: Ledger, result: Optional[GateResult] = None) -> Dict[str, Any]:
+    """The JSON report: per-run rows + aggregates (+ gate outcome)."""
+    ok_runs = ledger.ok_bench_runs()
+    doc: Dict[str, Any] = {
+        "runs": [r.to_dict() for r in ledger.runs],
+        "by_class": ledger.counts_by_class(),
+        "best_cells_per_sec": max(
+            (r.cells_per_sec for r in ok_runs), default=0.0
+        ),
+        "best_warmup_s": min(
+            (r.warmup_s for r in ok_runs if r.warmup_s is not None),
+            default=None,
+        ),
+        "healthy_trajectory": [
+            {"run": r.run_id, "cells_per_sec": r.cells_per_sec}
+            for r in ok_runs
+        ],
+    }
+    if result is not None:
+        doc["gate"] = result.to_dict()
+    return doc
+
+
+def _human_rate(v: float) -> str:
+    if v >= 1e9:
+        return f"{v / 1e9:.1f}B"
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    return f"{v:g}"
+
+
+def render_markdown(
+    ledger: Ledger, result: Optional[GateResult] = None
+) -> str:
+    """The human trend report."""
+    lines = [
+        "# Perf observatory",
+        "",
+        "| run | kind | class | cells/s | warmup_s | per-chip | note |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in ledger.runs:
+        per_chip = (
+            f"{_human_rate(r.cells_per_sec_per_chip)}"
+            + (" (virtual)" if r.virtual_mesh else "")
+            if r.cells_per_sec_per_chip is not None
+            else "-"
+        )
+        note = ""
+        if r.failure_class != "ok":
+            note = (r.error or "")[:80]
+        lines.append(
+            f"| {r.run_id} | {r.kind} | {r.failure_class} "
+            f"| {_human_rate(r.cells_per_sec) if r.cells_per_sec else '-'} "
+            f"| {r.warmup_s if r.warmup_s is not None else '-'} "
+            f"| {per_chip} | {note} |"
+        )
+    by_class = ledger.counts_by_class()
+    infra = sum(by_class[c] for c in INFRA_CLASSES)
+    lines += [
+        "",
+        f"- runs: {len(ledger.runs)} "
+        f"({', '.join(f'{c}={by_class[c]}' for c in FAILURE_CLASSES if by_class[c])})",
+        f"- infra flakes excluded from the trajectory: {infra}",
+    ]
+    ok_runs = ledger.ok_bench_runs()
+    if ok_runs:
+        best = max(ok_runs, key=lambda r: r.cells_per_sec)
+        lines.append(
+            f"- best healthy rate: {_human_rate(best.cells_per_sec)} "
+            f"cells/s ({best.run_id})"
+        )
+        warm = [r for r in ok_runs if r.warmup_s is not None]
+        if warm:
+            bw = min(warm, key=lambda r: r.warmup_s)
+            lines.append(
+                f"- best warmup: {bw.warmup_s}s ({bw.run_id})"
+            )
+    if result is not None:
+        lines += ["", "## Gate", "", "```", result.report(), "```"]
+    return "\n".join(lines) + "\n"
+
+
+def render(
+    ledger: Ledger,
+    fmt: str = "markdown",
+    result: Optional[GateResult] = None,
+) -> str:
+    if fmt == "json":
+        return json.dumps(trend(ledger, result), indent=2) + "\n"
+    if fmt == "prometheus":
+        publish(ledger, result)
+        return REGISTRY.render_prometheus()
+    return render_markdown(ledger, result)

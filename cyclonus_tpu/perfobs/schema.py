@@ -1,0 +1,154 @@
+"""The normalized run record every perf artifact reduces to.
+
+A BENCH wrapper ({n, cmd, rc, tail, parsed}), a bare bench JSON line
+(tools/tunnel_wait.py round artifacts), and a MULTICHIP dryrun wrapper
+all become one PerfRun, so the sentinel and the report never reason
+about file formats — only about runs.
+
+failure_class is the load-bearing field.  The five classes partition
+every observed round outcome:
+
+  ok              the run produced a positive rate
+  backend_init    the backend/compile service answered but failed
+                  (r03: "TPU backend setup/compile error (Unavailable)")
+  tunnel          the tunnel never answered — init join timeout, dead
+                  probe, or an rc=124 hang with no output past backend
+                  discovery (r04: "TPU tunnel dead or chip held")
+  watchdog_stall  bench.py's own watchdog fired inside a phase
+  engine          everything else: a real crash or wrong-verdict raise
+                  in the measured pipeline
+
+backend_init and tunnel are INFRA_CLASSES: the sentinel reports and
+gates them separately from engine regressions, because a flaky tunnel
+polluting the trajectory is exactly how rounds 3-4 lost their
+scoreboard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+FAILURE_CLASSES: Tuple[str, ...] = (
+    "ok",
+    "backend_init",
+    "tunnel",
+    "watchdog_stall",
+    "engine",
+)
+
+#: failure classes attributable to infrastructure (cold-start / tunnel),
+#: never to the measured engine — gated separately by the sentinel
+INFRA_CLASSES: Tuple[str, ...] = ("backend_init", "tunnel")
+
+
+@dataclass
+class PerfRun:
+    """One benchmark (or multichip dryrun) run, normalized."""
+
+    run_id: str  # "r03", "watchdog-20260731-104401", ...
+    kind: str  # "bench" | "multichip"
+    source: str  # path the run was ingested from
+    failure_class: str  # one of FAILURE_CLASSES
+    ok: bool
+    n: Optional[int] = None  # round number when the wrapper carries one
+    rc: Optional[int] = None
+    cells_per_sec: float = 0.0
+    cells_per_sec_per_chip: Optional[float] = None
+    # per-chip rate at max devices / single-device rate of the SAME
+    # workload (a mesh_scaling block with both rows) — the only
+    # apples-to-apples efficiency; rates from different problem sizes
+    # are never divided into each other
+    scaling_efficiency: Optional[float] = None
+    n_devices: Optional[int] = None
+    virtual_mesh: bool = False  # per-chip rate from a virtual CPU mesh
+    warmup_s: Optional[float] = None
+    # normalized per-phase wall-clock seconds: detail.phase_history_s
+    # merged with the named detail.*_s timings (build/encode/...)
+    phases: Dict[str, float] = field(default_factory=dict)
+    # detail.warmup_phases — the span-registry breakdown of warmup_s
+    warmup_phases: Dict[str, float] = field(default_factory=dict)
+    # flattened scalar counters/gauges from detail.telemetry.metrics
+    telemetry_counters: Dict[str, float] = field(default_factory=dict)
+    # cold-start forensics: backend-init attempts, backoff, outcome
+    retries: Dict[str, Any] = field(default_factory=dict)
+    error: Optional[str] = None
+    metric: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "run_id": self.run_id,
+            "kind": self.kind,
+            "source": self.source,
+            "failure_class": self.failure_class,
+            "ok": self.ok,
+            "n": self.n,
+            "rc": self.rc,
+            "cells_per_sec": self.cells_per_sec,
+            "cells_per_sec_per_chip": self.cells_per_sec_per_chip,
+            "scaling_efficiency": self.scaling_efficiency,
+            "n_devices": self.n_devices,
+            "virtual_mesh": self.virtual_mesh,
+            "warmup_s": self.warmup_s,
+            "phases": dict(self.phases),
+            "warmup_phases": dict(self.warmup_phases),
+            "telemetry_counters": dict(self.telemetry_counters),
+            "retries": dict(self.retries),
+            "error": self.error,
+            "metric": self.metric,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PerfRun":
+        if d.get("failure_class") not in FAILURE_CLASSES:
+            raise ValueError(
+                f"unknown failure_class {d.get('failure_class')!r} "
+                f"(expected one of {FAILURE_CLASSES})"
+            )
+        return cls(**d)
+
+    @property
+    def is_infra_failure(self) -> bool:
+        return self.failure_class in INFRA_CLASSES
+
+    def sort_key(self) -> Tuple[int, str]:
+        """Chronological-ish order: wrapper round number first, then
+        run_id (timestamped watchdog artifacts sort lexically)."""
+        return (self.n if self.n is not None else 1 << 30, self.run_id)
+
+
+def flatten_metric_samples(metrics: Dict[str, Any]) -> Dict[str, float]:
+    """detail.telemetry.metrics -> {family or family{k=v}: value} for
+    scalar (counter/gauge) samples.  Histograms are skipped — the ledger
+    keeps the counters the gate and report actually read."""
+    out: Dict[str, float] = {}
+    for name, fam in sorted((metrics or {}).items()):
+        if not isinstance(fam, dict) or fam.get("type") == "histogram":
+            continue
+        for sample in fam.get("samples", []):
+            labels = sample.get("labels") or {}
+            if labels:
+                inner = ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())
+                )
+                key = f"{name}{{{inner}}}"
+            else:
+                key = name
+            try:
+                out[key] = float(sample["value"])
+            except (KeyError, TypeError, ValueError):
+                continue
+    return out
+
+
+def phase_map(history: Optional[List[Any]]) -> Dict[str, float]:
+    """detail.phase_history_s ([["startup", 0.08], ...]) -> {phase: s},
+    summing repeated visits (compiled_parity re-enters its phase)."""
+    out: Dict[str, float] = {}
+    for item in history or []:
+        try:
+            name, seconds = item[0], float(item[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        out[str(name)] = out.get(str(name), 0.0) + seconds
+    return out

@@ -1,0 +1,365 @@
+"""The noise-aware regression sentinel (`cyclonus-tpu perf gate`).
+
+Gate posture, in order of precedence for the candidate (latest) run:
+
+  1. infra flake (failure_class backend_init | tunnel): reported with
+     the cold-start forensics (phase of death, retry counts) and gated
+     SEPARATELY — exit code 2, or 0 under --allow-infra.  Never counted
+     as an engine regression, and never admitted into baselines.
+  2. engine-side failure (watchdog_stall | engine): exit 1 — the run
+     died inside the measured pipeline.
+  3. healthy candidate: compared against min-of-N baselines built from
+     the last N prior HEALTHY runs only:
+       - cells_per_sec   >= best-of-N * (1 - rate_tol)
+       - warmup_s        <= best-of-N * (1 + warmup_tol) + warmup_slack
+       - each phase      <= best-of-N * (1 + phase_tol) + phase_slack
+       - scaling         cells_per_sec_per_chip / single-chip best
+                         >= min_scaling_efficiency (real meshes only:
+                         virtual CPU-mesh rates share one core and are
+                         reported, never gated)
+     Any violated bound is an engine regression: exit 1, with a delta
+     report NAMING the offending metric/phase.
+
+Min-of-N ("best of the last N") is the noise model: tunneled-chip
+timings jitter +-30% run to run (bench.py min-of-5 exists for the same
+reason), so a bound keyed to the mean would either flap or need a
+tolerance wide enough to hide real regressions.  The best-of window
+plus a relative tolerance plus a small absolute slack (for
+near-zero phases) tracks the envelope instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .ledger import Ledger
+from .schema import PerfRun
+
+#: phases the generic per-phase rule skips: warmup/eval have dedicated
+#: metrics (one regression, one finding), and backend_init_join is an
+#: INFRA wait (attach time on a cold/contended tunnel) — gating it as
+#: an engine regression would recreate the r03/r04 confusion; the
+#: cold-start forensics and failure classes cover it instead
+_DEDICATED_PHASES = frozenset({"warmup", "eval", "backend_init_join"})
+
+
+@dataclass
+class Delta:
+    """One gated comparison; `regressed` makes it a finding."""
+
+    metric: str  # "cells_per_sec", "warmup_s", "phase:encode", ...
+    candidate: float
+    baseline: float  # best-of-N
+    bound: float
+    regressed: bool
+    direction: str  # "min" (higher is better) | "max" (lower is better)
+    baseline_runs: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "metric": self.metric,
+            "candidate": self.candidate,
+            "baseline": self.baseline,
+            "bound": self.bound,
+            "regressed": self.regressed,
+            "direction": self.direction,
+            "baseline_runs": list(self.baseline_runs),
+        }
+
+
+@dataclass
+class GateResult:
+    status: str  # "pass" | "engine_regression" | "infra_flake" | "no_data"
+    candidate: Optional[str]  # run id
+    deltas: List[Delta] = field(default_factory=list)
+    infra: Dict[str, Any] = field(default_factory=dict)
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return {"pass": 0, "no_data": 0, "infra_flake": 2}.get(self.status, 1)
+
+    @property
+    def regressions(self) -> List[Delta]:
+        return [d for d in self.deltas if d.regressed]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "status": self.status,
+            "candidate": self.candidate,
+            "exit_code": self.exit_code,
+            "deltas": [d.to_dict() for d in self.deltas],
+            "infra": dict(self.infra),
+            "notes": list(self.notes),
+        }
+
+    def report(self) -> str:
+        """The delta report: one line per gated metric, offenders
+        first and flagged, so the failing phase is named in the first
+        screenful of CI output."""
+        lines = [f"perf gate: {self.status.upper()} (candidate {self.candidate})"]
+        for note in self.notes:
+            lines.append(f"  note: {note}")
+        if self.infra:
+            fr = self.infra
+            lines.append(
+                f"  infra: class={fr.get('failure_class')} "
+                f"phase={fr.get('died_in_phase')} "
+                f"attempts={fr.get('attempts')} error={fr.get('error')}"
+            )
+        for d in sorted(self.deltas, key=lambda d: not d.regressed):
+            mark = "REGRESSED" if d.regressed else "ok"
+            cmp_ = ">=" if d.direction == "min" else "<="
+            lines.append(
+                f"  [{mark}] {d.metric}: candidate={d.candidate:g} "
+                f"{cmp_} bound={d.bound:g} "
+                f"(best-of-{len(d.baseline_runs)} baseline={d.baseline:g} "
+                f"from {','.join(d.baseline_runs) or '-'})"
+            )
+        return "\n".join(lines)
+
+
+def _died_in_phase(run: PerfRun) -> Optional[str]:
+    """The last phase of the wall-clock history = where the run died."""
+    if not run.phases:
+        return None
+    # phases is insertion-ordered from phase_history_s; the named
+    # detail keys only exist on successful runs
+    return list(run.phases)[-1]
+
+
+def gate(
+    ledger: Ledger,
+    *,
+    baseline_n: int = 3,
+    rate_tol: float = 0.30,
+    warmup_tol: float = 0.50,
+    warmup_slack_s: float = 2.0,
+    phase_tol: float = 0.50,
+    phase_slack_s: float = 2.0,
+    min_scaling_efficiency: float = 0.5,
+    candidate: Optional[PerfRun] = None,
+) -> GateResult:
+    """Gate the candidate (default: latest bench run) against the
+    baselines formed by the prior healthy runs."""
+    bench = ledger.bench_runs()
+    if candidate is None:
+        candidate = bench[-1] if bench else None
+    if candidate is None:
+        return GateResult(
+            status="no_data",
+            candidate=None,
+            notes=["no bench runs ingested — nothing to gate"],
+        )
+
+    priors = [
+        r
+        for r in bench
+        if r.failure_class == "ok" and r.sort_key() < candidate.sort_key()
+    ]
+    baselines = priors[-baseline_n:]
+    base_ids = [r.run_id for r in baselines]
+
+    infra_counts = {
+        k: v for k, v in ledger.counts_by_class().items() if v
+    }
+    notes = [f"history: {infra_counts}"]
+
+    if candidate.is_infra_failure:
+        return GateResult(
+            status="infra_flake",
+            candidate=candidate.run_id,
+            infra={
+                "failure_class": candidate.failure_class,
+                "died_in_phase": _died_in_phase(candidate),
+                "attempts": candidate.retries.get("attempts"),
+                "backoff_s": candidate.retries.get("backoff_s"),
+                "error": candidate.error,
+            },
+            notes=notes
+            + [
+                "infra flake, NOT an engine regression — the engine "
+                "was never reached; trajectory baselines are unchanged"
+            ],
+        )
+    if candidate.failure_class in ("watchdog_stall", "engine"):
+        return GateResult(
+            status="engine_regression",
+            candidate=candidate.run_id,
+            infra={
+                "failure_class": candidate.failure_class,
+                "died_in_phase": _died_in_phase(candidate),
+                "error": candidate.error,
+            },
+            notes=notes + ["run failed inside the measured pipeline"],
+        )
+
+    deltas: List[Delta] = []
+    if not baselines:
+        notes.append(
+            "no healthy prior runs — candidate admitted as the first baseline"
+        )
+
+    # --- throughput: higher is better, best-of-N baseline ---------------
+    rates = [r.cells_per_sec for r in baselines if r.cells_per_sec > 0]
+    if rates and candidate.cells_per_sec > 0:
+        best = max(rates)
+        bound = best * (1.0 - rate_tol)
+        deltas.append(
+            Delta(
+                metric="cells_per_sec",
+                candidate=candidate.cells_per_sec,
+                baseline=best,
+                bound=bound,
+                regressed=candidate.cells_per_sec < bound,
+                direction="min",
+                baseline_runs=base_ids,
+            )
+        )
+
+    # --- warmup: lower is better, min-of-N baseline ---------------------
+    warmups = [
+        r.warmup_s for r in baselines if isinstance(r.warmup_s, (int, float))
+    ]
+    if warmups and isinstance(candidate.warmup_s, (int, float)):
+        best = min(warmups)
+        bound = best * (1.0 + warmup_tol) + warmup_slack_s
+        deltas.append(
+            Delta(
+                metric="warmup_s",
+                candidate=candidate.warmup_s,
+                baseline=best,
+                bound=bound,
+                regressed=candidate.warmup_s > bound,
+                direction="max",
+                baseline_runs=base_ids,
+            )
+        )
+
+    # --- per-phase bounds: every phase both sides know ------------------
+    for phase, cand_s in sorted(candidate.phases.items()):
+        if phase in _DEDICATED_PHASES:
+            continue
+        prior_s = [
+            r.phases[phase] for r in baselines if phase in r.phases
+        ]
+        if not prior_s:
+            continue
+        best = min(prior_s)
+        bound = best * (1.0 + phase_tol) + phase_slack_s
+        deltas.append(
+            Delta(
+                metric=f"phase:{phase}",
+                candidate=cand_s,
+                baseline=best,
+                bound=bound,
+                regressed=cand_s > bound,
+                direction="max",
+                baseline_runs=base_ids,
+            )
+        )
+
+    # --- multichip scaling efficiency -----------------------------------
+    # cells/s-per-chip vs single-chip (ROADMAP item 3's missing gate),
+    # with two hard rules about comparability:
+    #   * efficiency is only ever computed WITHIN one workload — a
+    #     mesh_scaling block's N-dev per-chip rate over its own 1-dev
+    #     rate (PerfRun.scaling_efficiency, set at ingest).  A tiny
+    #     multichip dryrun's rate divided by the 100k-pod headline
+    #     would "regress" on problem size, not on scaling.
+    #   * only REAL meshes gate: a virtual CPU mesh timeshares one
+    #     core, so its per-chip rate divides by n_dev by construction.
+    gated_scaling = False
+    if candidate.scaling_efficiency is not None:
+        if candidate.virtual_mesh:
+            notes.append(
+                "scaling: candidate efficiency "
+                f"{candidate.scaling_efficiency:g} is from a VIRTUAL "
+                "mesh — reported, not gated"
+            )
+        else:
+            gated_scaling = True
+            deltas.append(
+                Delta(
+                    metric=(
+                        f"scaling_efficiency[{candidate.run_id}"
+                        f"@{candidate.n_devices}chip]"
+                    ),
+                    candidate=candidate.scaling_efficiency,
+                    baseline=1.0,
+                    bound=min_scaling_efficiency,
+                    regressed=candidate.scaling_efficiency
+                    < min_scaling_efficiency,
+                    direction="min",
+                    baseline_runs=[candidate.run_id],
+                )
+            )
+    # trend leg: the latest REAL multichip per-chip rate against prior
+    # real multichip runs at the SAME device count (same dryrun
+    # workload) — min-of-N like the headline rate
+    mc_real = [
+        r
+        for r in ledger.multichip_runs()
+        if r.cells_per_sec_per_chip is not None and not r.virtual_mesh
+    ]
+    if mc_real:
+        mc = mc_real[-1]
+        gated_scaling = True
+        mc_priors = [
+            r.cells_per_sec_per_chip
+            for r in mc_real[:-1]
+            if r.n_devices == mc.n_devices
+        ][-baseline_n:]
+        if mc_priors:
+            best_mc = max(mc_priors)
+            bound = best_mc * (1.0 - rate_tol)
+            deltas.append(
+                Delta(
+                    metric=(
+                        f"cells_per_sec_per_chip[{mc.run_id}"
+                        f"@{mc.n_devices}chip]"
+                    ),
+                    candidate=mc.cells_per_sec_per_chip,
+                    baseline=best_mc,
+                    bound=bound,
+                    regressed=mc.cells_per_sec_per_chip < bound,
+                    direction="min",
+                    baseline_runs=[
+                        r.run_id
+                        for r in mc_real[:-1]
+                        if r.n_devices == mc.n_devices
+                    ][-baseline_n:],
+                )
+            )
+        else:
+            notes.append(
+                f"scaling: {mc.run_id} is the first real multichip "
+                f"run at {mc.n_devices} devices — admitted as baseline"
+            )
+    if not gated_scaling:
+        if any(
+            r.cells_per_sec_per_chip is not None for r in ledger.runs
+        ):
+            notes.append(
+                "scaling: all recorded per-chip rates are from VIRTUAL "
+                "meshes — reported, not gated"
+            )
+        else:
+            notes.append(
+                "scaling: no multichip per-chip rate recorded yet — "
+                "gate skipped (runs record cells_per_sec_per_chip "
+                "from now on)"
+            )
+
+    status = (
+        "engine_regression"
+        if any(d.regressed for d in deltas)
+        else "pass"
+    )
+    return GateResult(
+        status=status,
+        candidate=candidate.run_id,
+        deltas=deltas,
+        notes=notes,
+    )

@@ -121,6 +121,31 @@ AUTOTUNE_OUTCOMES = REGISTRY.counter(
     labelnames=("outcome",),
 )
 
+# --- cold-start forensics ------------------------------------------------
+# Rounds 3-4 lost their scoreboard to backend/tunnel init; these count
+# every attach/probe attempt so a flaky cold start is a labeled series,
+# not a mystery (bench.py detail.cold_start and tools/tunnel_wait.py
+# both feed them; the perfobs sentinel gates infra separately on the
+# resulting failure_class).
+
+BACKEND_INIT_ATTEMPTS = REGISTRY.counter(
+    "cyclonus_tpu_backend_init_attempts_total",
+    "TPU backend attach attempts (bench.py overlapped init thread, "
+    "jittered-backoff retries), by outcome (ok/error).",
+    labelnames=("outcome",),
+)
+BACKEND_INIT_BACKOFF_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_backend_init_backoff_seconds",
+    "Total jittered backoff slept between backend attach attempts in "
+    "the most recent init sequence.",
+)
+TUNNEL_PROBE_ATTEMPTS = REGISTRY.counter(
+    "cyclonus_tpu_tunnel_probe_attempts_total",
+    "Bounded subprocess tunnel probes (tools/tunnel_wait.py), by "
+    "outcome (alive/dead/timeout).",
+    labelnames=("outcome",),
+)
+
 # --- real-probe latency --------------------------------------------------
 
 PROBE_LATENCY = REGISTRY.histogram(

@@ -53,6 +53,9 @@ class TestBenchGuards:
         assert "watchdog" in out["error"]
         assert out["value"] == 0
         assert out["vs_baseline"] == 0.0
+        # the perfobs ledger gates on this: a watchdog kill inside the
+        # measured pipeline is an ENGINE-side failure class
+        assert out["failure_class"] == "watchdog_stall"
         phases = [p[0] for p in out["detail"]["phase_history_s"]]
         assert "startup" in phases  # history present and labeled
 
@@ -115,11 +118,49 @@ class TestBenchGuards:
         out = last_json_line(proc.stdout)
         assert "backend init did not complete" in out["error"]
         assert out["value"] == 0
+        # classified INFRA (the tunnel never answered), with the
+        # cold-start forensics riding the artifact — what lets the
+        # perfobs sentinel keep r03/r04-style runs out of the
+        # engine-regression lane
+        assert out["failure_class"] == "tunnel"
+        cold = out["detail"]["cold_start"]
+        assert cold["outcome"] == "tunnel"
+        assert cold["attempts"] >= 1
         leg = out["detail"]["cpu_fallback"]
         assert leg["backend"] == "cpu"
         assert leg["value"] > 0
         assert leg["unit"] == "cells/sec"
         assert "128 pods" in leg["metric"]
+
+    def test_init_error_midretry_classifies_backend_init(self):
+        """An init attempt that FAILED (backend answered) followed by a
+        join deadline mid-backoff must classify backend_init with the
+        captured error — not 'tunnel dead', which would discard the
+        evidence (the r03-vs-r04 distinction)."""
+        proc = run_bench(
+            {
+                "BENCH_FAKE_INIT_ERROR": "1",
+                "BENCH_INIT_RETRIES": "3",
+                "BENCH_INIT_BACKOFF_S": "30",  # deadline fires mid-backoff
+                "BENCH_INIT_DEADLINE_S": "2",
+                "BENCH_PODS": "64",
+                "BENCH_POLICIES": "8",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+                "BENCH_CPU_FALLBACK": "0",
+                "BENCH_DEADLINE_S": "0",
+                "BENCH_STALL_S": "0",
+            },
+            timeout=120,
+        )
+        assert proc.returncode == 4
+        out = last_json_line(proc.stdout)
+        assert out["failure_class"] == "backend_init"
+        assert "fake backend init error" in out["error"]
+        cold = out["detail"]["cold_start"]
+        assert cold["outcome"] == "backend_init"
+        assert cold["attempts"] >= 1
+        assert cold["backoff_s"] > 0
 
     def test_trace_dir_records_written_artifact(self, tmp_path):
         """BENCH_TRACE_DIR (= bench.py --trace-dir) wraps the eval phase
@@ -160,7 +201,21 @@ class TestBenchGuards:
         assert "error" not in out
         assert out["unit"] == "cells/sec"
         assert out["value"] > 0
+        # healthy runs SAY so — the perfobs ledger never infers "ok"
+        # from an absent error field
+        assert out["failure_class"] == "ok"
         detail = out["detail"]
+        # the per-phase wall-clock history now rides success lines too
+        # (perfobs per-phase bounds need it from healthy runs)
+        phases = [p[0] for p in detail["phase_history_s"]]
+        assert phases[0] == "startup"
+        assert "warmup" in phases and "eval" in phases
+        # cold-start forensics: the overlapped init thread attached on
+        # a counted attempt
+        cold = detail["cold_start"]
+        assert cold["outcome"] == "ok"
+        assert cold["attempts"] >= 1
+        assert cold["backend_init_s"] is not None
         assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
         # roofline only reports for the pallas backend
         assert detail["roofline"] is None

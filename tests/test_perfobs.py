@@ -1,0 +1,738 @@
+"""Tests for the perf observatory (cyclonus_tpu/perfobs/): ledger
+ingestion + failure classification over the REAL round artifacts,
+seeded regression/no-regression gate cases, round-trip, the Prometheus
+exposition golden, and the CLI/Makefile wiring.
+
+The five BENCH_r0*.json / MULTICHIP_r0*.json blobs in the repo root are
+the acceptance fixtures: they must ingest UNCHANGED, r03/r04 must
+classify as infra (backend_init/tunnel), and the r01->r05 trajectory
+must pass the gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cyclonus_tpu.perfobs import (  # noqa: E402
+    Ledger,
+    PerfRun,
+    classify,
+    gate,
+    ingest_bench,
+    ingest_multichip,
+    load_ledger,
+)
+from cyclonus_tpu.perfobs import report as perf_report  # noqa: E402
+
+
+# --- fixture builders ----------------------------------------------------
+
+
+def healthy_line(
+    value=100e9, warmup=5.0, encode=1.0, mesh_rows=None, virtual=True
+):
+    detail = {
+        "build_s": 0.5,
+        "encode_s": encode,
+        "backend_init_s": 0.1,
+        "phase_history_s": [
+            ["startup", 0.1],
+            ["synthetic_build", 0.4],
+            ["matcher_build", 0.5],
+            ["encode", encode],
+            ["backend_init_join", 0.1],
+            ["warmup", warmup],
+            ["eval", 1.0],
+        ],
+        "cold_start": {
+            "attempts": 1,
+            "backoff_s": 0.0,
+            "backend_init_s": 0.1,
+            "outcome": "ok",
+        },
+        "warmup_s": warmup,
+        "warmup_phases": {"engine.dispatch": warmup * 0.4},
+        "eval_s": 0.2,
+        "telemetry": {
+            "metrics": {
+                "cyclonus_tpu_pre_cache_hits_total": {
+                    "type": "counter",
+                    "help": "h",
+                    "samples": [{"labels": {}, "value": 4.0}],
+                }
+            }
+        },
+    }
+    if mesh_rows is not None:
+        detail["mesh_scaling"] = {
+            "pods": 64,
+            "virtual": virtual,
+            "rows": mesh_rows,
+        }
+    return {
+        "metric": "simulated connectivity cells/sec (bench)",
+        "value": value,
+        "unit": "cells/sec",
+        "vs_baseline": value / 1e9,
+        "failure_class": "ok",
+        "detail": detail,
+    }
+
+
+def wrap(n, parsed, rc=0, tail=""):
+    return {"n": n, "cmd": "python bench.py", "rc": rc,
+            "tail": tail, "parsed": parsed}
+
+
+def write_rounds(tmp_path, docs):
+    for i, doc in enumerate(docs, start=1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(doc))
+    return str(tmp_path)
+
+
+R03_STYLE_TAIL = (
+    "WARNING: Platform 'axon' is experimental\n"
+    "/opt/venv/.../compiler.py:783: UserWarning: Error reading persistent "
+    "compilation cache entry for 'jit__lambda': JaxRuntimeError: "
+    "UNAVAILABLE: TPU backend setup/compile error (Unavailable).\n"
+    "  warnings.warn(\n"
+)
+
+
+# --- classification over the REAL round artifacts ------------------------
+
+
+class TestRealArtifacts:
+    """The acceptance fixtures: the five committed BENCH/MULTICHIP blobs
+    ingest unchanged and classify the way the rounds actually went."""
+
+    def test_bench_rounds_classify(self):
+        led = load_ledger(REPO)
+        by_id = {r.run_id: r for r in led.bench_runs()}
+        assert set(by_id) >= {"r01", "r02", "r03", "r04", "r05"}
+        assert by_id["r01"].failure_class == "ok"
+        assert by_id["r02"].failure_class == "ok"
+        # r03 died on the backend/compile service answering Unavailable;
+        # r04 timed out joining a tunnel that never answered — INFRA,
+        # not engine regressions
+        assert by_id["r03"].failure_class == "backend_init"
+        assert by_id["r04"].failure_class == "tunnel"
+        assert by_id["r03"].is_infra_failure
+        assert by_id["r04"].is_infra_failure
+        assert by_id["r05"].failure_class == "ok"
+        assert by_id["r05"].cells_per_sec == 132717279525.0
+        # r04 recorded the phase it died in
+        assert list(by_id["r04"].phases)[-1] == "backend_init_join"
+
+    def test_multichip_rounds_classify(self):
+        led = load_ledger(REPO)
+        by_id = {r.run_id: r for r in led.multichip_runs()}
+        assert by_id["multichip_r03"].failure_class == "tunnel"
+        assert by_id["multichip_r04"].failure_class == "ok"
+        assert by_id["multichip_r05"].failure_class == "ok"
+        # r01 was a real libtpu/code mismatch at device_put — backend
+        assert by_id["multichip_r01"].failure_class == "backend_init"
+
+    def test_gate_passes_on_real_trajectory(self):
+        led = load_ledger(REPO)
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert result.exit_code == 0
+        assert result.candidate == "r05"
+        # the trajectory gated on rate and warmup with r01/r02 baselines
+        metrics = {d.metric for d in result.deltas}
+        assert "cells_per_sec" in metrics
+        assert "warmup_s" in metrics
+
+
+# --- ledger unit behavior ------------------------------------------------
+
+
+class TestLedger:
+    def test_classify_explicit_wins(self):
+        assert classify({"failure_class": "tunnel", "value": 5}) == "tunnel"
+
+    def test_classify_watchdog(self):
+        assert (
+            classify({"error": "watchdog: stalled 300s in phase 'warmup'"})
+            == "watchdog_stall"
+        )
+
+    def test_truncated_json_is_failed_run(self, tmp_path):
+        p = tmp_path / "BENCH_r01.json"
+        p.write_text('{"n": 1, "rc": 2, "tail": "x", "par')
+        run = ingest_bench(str(p))
+        assert run.ok is False
+        assert "unparseable JSON" in run.error
+        assert run.failure_class == "engine"  # no infra evidence
+
+    def test_r03_style_wrapper(self, tmp_path):
+        p = tmp_path / "BENCH_r03.json"
+        p.write_text(json.dumps(wrap(3, None, rc=124, tail=R03_STYLE_TAIL)))
+        run = ingest_bench(str(p))
+        assert run.failure_class == "backend_init"
+        assert run.rc == 124
+        # the quoted error is the signature line, not warnings.warn(
+        assert "UNAVAILABLE" in run.error
+
+    def test_silent_rc124_hang_is_tunnel(self, tmp_path):
+        p = tmp_path / "BENCH_r09.json"
+        p.write_text(json.dumps(wrap(9, None, rc=124, tail="WARNING: axon\n")))
+        assert ingest_bench(str(p)).failure_class == "tunnel"
+
+    def test_bare_tunnel_wait_artifact(self, tmp_path):
+        doc = healthy_line(value=9e9)
+        doc["bench_rc"] = 0
+        doc["at"] = "2026-08-03T00:00:00"
+        p = tmp_path / "bench_watchdog_latest.json"
+        p.write_text(json.dumps(doc))
+        run = ingest_bench(str(p))
+        assert run.failure_class == "ok"
+        assert run.cells_per_sec == 9e9
+        assert run.run_id == "bench_watchdog_latest"
+
+    def test_normalized_run_fields(self, tmp_path):
+        root = write_rounds(tmp_path, [wrap(1, healthy_line())])
+        run = load_ledger(root).bench_runs()[0]
+        assert run.warmup_s == 5.0
+        assert run.phases["encode"] == 1.0
+        assert run.phases["startup"] == 0.1  # from phase_history_s
+        assert run.warmup_phases == {"engine.dispatch": 2.0}
+        assert run.telemetry_counters == {
+            "cyclonus_tpu_pre_cache_hits_total": 4.0
+        }
+        assert run.retries["attempts"] == 1
+
+    def test_round_trip(self, tmp_path):
+        root = write_rounds(
+            tmp_path,
+            [wrap(1, healthy_line()), wrap(2, None, rc=124, tail="x")],
+        )
+        led = load_ledger(root)
+        led2 = Ledger.from_dict(led.to_dict())
+        assert led2.to_dict() == led.to_dict()
+        assert [r.run_id for r in led2.runs] == [r.run_id for r in led.runs]
+
+    def test_from_dict_rejects_unknown_class(self):
+        with pytest.raises(ValueError, match="failure_class"):
+            PerfRun.from_dict(
+                {"run_id": "x", "kind": "bench", "source": "s",
+                 "failure_class": "gremlins", "ok": False}
+            )
+
+    def test_multichip_per_chip_line_parsed(self, tmp_path):
+        tail = (
+            "dryrun_multichip OK: 8-device mesh\n"
+            + json.dumps(
+                {"metric": "multichip sharded counts cells/sec",
+                 "n_devices": 8, "cells_per_sec": 8.0e9,
+                 "cells_per_sec_per_chip": 1.0e9, "virtual": False}
+            )
+            + "\n"
+        )
+        p = tmp_path / "MULTICHIP_r01.json"
+        p.write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True, "tail": tail}
+        ))
+        run = ingest_multichip(str(p))
+        assert run.failure_class == "ok"
+        assert run.cells_per_sec_per_chip == 1.0e9
+        assert run.n_devices == 8
+        assert run.virtual_mesh is False
+
+
+# --- the regression sentinel ---------------------------------------------
+
+
+class TestGate:
+    def _ledger(self, *docs, tmp_path):
+        return load_ledger(write_rounds(tmp_path, list(docs)))
+
+    def test_no_regression_passes(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=90e9, warmup=6.0)),
+            wrap(2, healthy_line(value=100e9, warmup=5.0)),
+            wrap(3, healthy_line(value=110e9, warmup=5.5)),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert not result.regressions
+
+    def test_rate_regression_fails(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=110e9)),
+            wrap(3, healthy_line(value=50e9)),  # 55% drop vs best
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        assert result.exit_code == 1
+        bad = {d.metric for d in result.regressions}
+        assert "cells_per_sec" in bad
+        assert "REGRESSED] cells_per_sec" in result.report()
+
+    def test_warmup_regression_fails_named(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(warmup=5.0)),
+            wrap(2, healthy_line(warmup=6.0)),
+            wrap(3, healthy_line(value=120e9, warmup=60.0)),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        assert {d.metric for d in result.regressions} == {"warmup_s"}
+        assert "warmup_s" in result.report()
+
+    def test_phase_regression_names_phase(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(encode=1.0)),
+            wrap(2, healthy_line(encode=1.2)),
+            wrap(3, healthy_line(value=120e9, encode=30.0)),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        assert {d.metric for d in result.regressions} == {"phase:encode"}
+        # the delta report NAMES the offending phase
+        assert "phase:encode" in result.report()
+
+    def test_noise_within_tolerance_passes(self, tmp_path):
+        # -25% rate and +40% warmup are inside the default envelope
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9, warmup=5.0)),
+            wrap(2, healthy_line(value=75e9, warmup=7.0)),
+            tmp_path=tmp_path,
+        )
+        assert gate(led).status == "pass"
+
+    def test_infra_flake_gates_separately(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, None, rc=3, tail=""),
+            tmp_path=tmp_path,
+        )
+        # make round 2 an init-timeout artifact like r04
+        led.runs[1].failure_class = "tunnel"
+        led.runs[1].ok = False
+        result = gate(led)
+        assert result.status == "infra_flake"
+        assert result.exit_code == 2
+        assert result.infra["failure_class"] == "tunnel"
+        assert "NOT an engine regression" in result.report()
+
+    def test_infra_runs_never_pollute_baselines(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, None, rc=124, tail=R03_STYLE_TAIL),  # backend_init
+            wrap(3, healthy_line(value=95e9)),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass"
+        rate = next(d for d in result.deltas if d.metric == "cells_per_sec")
+        assert rate.baseline_runs == ["r01"]  # r02 excluded
+
+    def test_first_run_is_admitted(self, tmp_path):
+        led = self._ledger(wrap(1, healthy_line()), tmp_path=tmp_path)
+        result = gate(led)
+        assert result.status == "pass"
+        assert any("first baseline" in n for n in result.notes)
+
+    def test_engine_crash_is_regression(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line()),
+            wrap(2, {"metric": "m (FAILED)", "value": 0,
+                     "error": "AssertionError: PARITY FAILURE",
+                     "failure_class": "engine", "detail": {}}, rc=1),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        assert result.exit_code == 1
+
+    def test_scaling_gate_real_mesh(self, tmp_path):
+        rows_bad = [
+            {"path": "ring", "devices": 1, "eval_s": 1.0,
+             "cells_per_sec": 100e9, "cells_per_sec_per_chip": 100e9,
+             "counts_ok": True},
+            {"path": "ring", "devices": 8, "eval_s": 1.0,
+             "cells_per_sec": 160e9, "cells_per_sec_per_chip": 20e9,
+             "counts_ok": True},
+        ]
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=100e9, mesh_rows=rows_bad,
+                                 virtual=False)),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        (delta,) = [d for d in result.regressions]
+        assert delta.metric.startswith("scaling_efficiency")
+        assert "@8chip" in delta.metric
+
+    def test_scaling_gate_healthy_real_mesh_passes(self, tmp_path):
+        rows_ok = [
+            {"path": "ring", "devices": 1, "eval_s": 1.0,
+             "cells_per_sec": 100e9, "cells_per_sec_per_chip": 100e9,
+             "counts_ok": True},
+            {"path": "ring", "devices": 8, "eval_s": 1.0,
+             "cells_per_sec": 640e9, "cells_per_sec_per_chip": 80e9,
+             "counts_ok": True},
+        ]
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=100e9, mesh_rows=rows_ok,
+                                 virtual=False)),
+            tmp_path=tmp_path,
+        )
+        assert led.runs[-1].scaling_efficiency == pytest.approx(0.8)
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert any(
+            d.metric.startswith("scaling_efficiency") for d in result.deltas
+        )
+
+    def test_efficiency_is_same_workload_only(self, tmp_path):
+        """Without a 1-device row of the SAME workload there is no
+        efficiency — the gate must never divide an N-dev per-chip rate
+        by the (different-problem-size) headline single-chip rate."""
+        rows = [
+            {"path": "ring", "devices": 8, "eval_s": 1.0,
+             "cells_per_sec": 8e6, "cells_per_sec_per_chip": 1e6,
+             "counts_ok": True},
+        ]
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=100e9, mesh_rows=rows,
+                                 virtual=False)),
+            tmp_path=tmp_path,
+        )
+        assert led.runs[-1].scaling_efficiency is None
+        result = gate(led)
+        # the tiny per-chip rate (1e6 vs the 100e9 headline) must NOT
+        # read as a scaling regression — different workloads
+        assert result.status == "pass", result.report()
+
+    def test_virtual_mesh_reported_not_gated(self, tmp_path):
+        rows = [
+            {"path": "ring", "devices": 1, "eval_s": 1.0,
+             "cells_per_sec": 100e6, "cells_per_sec_per_chip": 100e6,
+             "counts_ok": True},
+            {"path": "ring", "devices": 8, "eval_s": 1.0,
+             "cells_per_sec": 100e6, "cells_per_sec_per_chip": 12.5e6,
+             "counts_ok": True},
+        ]
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=100e9, mesh_rows=rows)),  # virtual
+            tmp_path=tmp_path,
+        )
+        # efficiency 0.125 exists (one core timeshared 8 ways) but the
+        # block is virtual: reported in a note, never a delta
+        assert led.runs[-1].scaling_efficiency == pytest.approx(0.125)
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert not any(
+            d.metric.startswith("scaling_efficiency") for d in result.deltas
+        )
+        assert any("VIRTUAL" in n for n in result.notes)
+
+    def _multichip(self, tmp_path, name, per_chip, n_devices=8,
+                   virtual=False):
+        tail = (
+            "dryrun_multichip OK\n"
+            + json.dumps(
+                {"metric": "multichip sharded counts cells/sec",
+                 "n_devices": n_devices, "cells_per_sec":
+                 per_chip * n_devices,
+                 "cells_per_sec_per_chip": per_chip,
+                 "virtual": virtual}
+            )
+            + "\n"
+        )
+        (tmp_path / name).write_text(json.dumps(
+            {"n_devices": n_devices, "rc": 0, "ok": True, "tail": tail}
+        ))
+
+    def test_multichip_trend_gate_same_device_count(self, tmp_path):
+        """Real multichip per-chip rates gate against prior real runs
+        at the SAME device count (same dryrun workload)."""
+        write_rounds(tmp_path, [wrap(1, healthy_line(value=100e9))])
+        self._multichip(tmp_path, "MULTICHIP_r01.json", 10e9)
+        self._multichip(tmp_path, "MULTICHIP_r02.json", 2e9)  # -80%
+        led = load_ledger(str(tmp_path))
+        result = gate(led)
+        assert result.status == "engine_regression", result.report()
+        (delta,) = result.regressions
+        assert delta.metric.startswith("cells_per_sec_per_chip")
+        assert "@8chip" in delta.metric
+
+    def test_first_real_multichip_is_admitted(self, tmp_path):
+        """A lone tiny real-mesh dryrun must not spuriously fail any
+        absolute gate — it becomes the first per-chip baseline."""
+        write_rounds(tmp_path, [wrap(1, healthy_line(value=100e9))])
+        self._multichip(tmp_path, "MULTICHIP_r01.json", 1e6)  # tiny
+        led = load_ledger(str(tmp_path))
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert any("first real multichip" in n for n in result.notes)
+
+    def test_backend_init_join_phase_not_engine_gated(self, tmp_path):
+        """Attach wait is INFRA: a healthy run on a cold/contended
+        tunnel (long backend_init_join) must not read as an engine
+        regression — the cold-start forensics cover it."""
+        slow = healthy_line(value=120e9)
+        slow["detail"]["backend_init_s"] = 45.0
+        led = self._ledger(
+            wrap(1, healthy_line()),
+            wrap(2, healthy_line()),
+            wrap(3, slow),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert not any(
+            d.metric == "phase:backend_init_join" for d in result.deltas
+        )
+
+
+# --- report + Prometheus golden ------------------------------------------
+
+
+class TestReport:
+    def _small_ledger(self):
+        runs = [
+            PerfRun(
+                run_id="rA", kind="bench", source="a", failure_class="ok",
+                ok=True, n=1, cells_per_sec=100e9, warmup_s=5.0,
+                phases={"encode": 1.0},
+            ),
+            PerfRun(
+                run_id="rB", kind="bench", source="b",
+                failure_class="tunnel", ok=False, n=2,
+                error="backend init did not complete",
+            ),
+            PerfRun(
+                run_id="mc", kind="multichip", source="m",
+                failure_class="ok", ok=True, n_devices=8,
+                cells_per_sec=100e9, cells_per_sec_per_chip=12.5e9,
+                virtual_mesh=True,
+            ),
+        ]
+        return Ledger(runs)
+
+    def test_markdown_trend(self):
+        led = self._small_ledger()
+        md = perf_report.render_markdown(led, gate(led))
+        assert "| rA | bench | ok | 100.0B | 5.0 |" in md
+        assert "| rB | bench | tunnel |" in md
+        assert "12.5B (virtual)" in md
+        assert "best healthy rate: 100.0B cells/s (rA)" in md
+        assert "infra flakes excluded from the trajectory: 1" in md
+
+    def test_json_trend(self):
+        led = self._small_ledger()
+        doc = perf_report.trend(led, gate(led))
+        assert doc["best_cells_per_sec"] == 100e9
+        assert doc["by_class"]["tunnel"] == 1
+        assert doc["gate"]["status"] == "infra_flake"  # rB is latest
+        assert doc["healthy_trajectory"] == [
+            {"run": "rA", "cells_per_sec": 100e9}
+        ]
+
+    def test_prometheus_exposition_golden(self):
+        """Byte-stable golden of the cyclonus_tpu_perf_* sample lines
+        (the schema a scraper of any --metrics-port process sees after
+        publish)."""
+        from cyclonus_tpu.telemetry.metrics import REGISTRY
+
+        REGISTRY.reset()
+        led = self._small_ledger()
+        perf_report.publish(led, gate(led))
+        got = [
+            line
+            for line in REGISTRY.render_prometheus().splitlines()
+            if line.startswith("cyclonus_tpu_perf_")
+        ]
+        assert got == [
+            'cyclonus_tpu_perf_best_cells_per_sec 100000000000',
+            'cyclonus_tpu_perf_cells_per_sec{run="rA"} 100000000000',
+            'cyclonus_tpu_perf_cells_per_sec{run="rB"} 0',
+            'cyclonus_tpu_perf_cells_per_sec_per_chip{run="mc",virtual="1"} 12500000000',
+            'cyclonus_tpu_perf_gate_status 2',
+            'cyclonus_tpu_perf_phase_seconds{run="rA",phase="encode"} 1',
+            'cyclonus_tpu_perf_runs{failure_class="backend_init"} 0',
+            'cyclonus_tpu_perf_runs{failure_class="engine"} 0',
+            'cyclonus_tpu_perf_runs{failure_class="ok"} 2',
+            'cyclonus_tpu_perf_runs{failure_class="tunnel"} 1',
+            'cyclonus_tpu_perf_runs{failure_class="watchdog_stall"} 0',
+            'cyclonus_tpu_perf_warmup_seconds{run="rA"} 5',
+        ]
+
+    def test_served_by_metrics_server(self):
+        """The gauges ride the EXISTING telemetry server: publish, then
+        curl /metrics on an ephemeral port."""
+        from urllib.request import urlopen
+
+        from cyclonus_tpu.telemetry.server import (
+            start_metrics_server,
+            stop_metrics_server,
+        )
+
+        led = self._small_ledger()
+        perf_report.publish(led)
+        srv = start_metrics_server(0)
+        try:
+            body = urlopen(f"{srv.url}/metrics", timeout=10).read().decode()
+        finally:
+            stop_metrics_server()
+        assert 'cyclonus_tpu_perf_cells_per_sec{run="rA"}' in body
+
+
+# --- CLI + Makefile wiring -----------------------------------------------
+
+
+class TestCli:
+    def _cli(self, *args, cwd=REPO):
+        return subprocess.run(
+            [sys.executable, "-m", "cyclonus_tpu", *args],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=cwd,
+        )
+
+    def test_gate_passes_in_repo(self):
+        proc = self._cli("perf", "gate")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "PASS" in proc.stdout
+        assert "candidate r05" in proc.stdout
+
+    def test_gate_json_output(self):
+        proc = self._cli("perf", "gate", "--json")
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["status"] == "pass"
+        assert doc["candidate"] == "r05"
+
+    def test_gate_fails_on_regressed_fixture_dir(self, tmp_path):
+        write_rounds(
+            tmp_path,
+            [
+                wrap(1, healthy_line(value=100e9)),
+                wrap(2, healthy_line(value=30e9, warmup=80.0)),
+            ],
+        )
+        proc = self._cli("perf", "gate", "--dir", str(tmp_path))
+        assert proc.returncode == 1
+        assert "REGRESSED] cells_per_sec" in proc.stdout
+        assert "warmup_s" in proc.stdout
+
+    def test_gate_infra_exit_code_and_allow_infra(self, tmp_path):
+        write_rounds(
+            tmp_path,
+            [
+                wrap(1, healthy_line(value=100e9)),
+                wrap(2, None, rc=124, tail=R03_STYLE_TAIL),
+            ],
+        )
+        proc = self._cli("perf", "gate", "--dir", str(tmp_path))
+        assert proc.returncode == 2
+        assert "INFRA_FLAKE" in proc.stdout
+        proc = self._cli(
+            "perf", "gate", "--dir", str(tmp_path), "--allow-infra"
+        )
+        assert proc.returncode == 0
+
+    def test_report_json_over_repo(self):
+        proc = self._cli("perf", "report", "--format", "json")
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        ids = {r["run_id"] for r in doc["runs"]}
+        assert {"r01", "r02", "r03", "r04", "r05"} <= ids
+        assert doc["best_cells_per_sec"] == 132717279525.0
+        assert doc["gate"]["status"] == "pass"
+
+    def test_report_out_file(self, tmp_path):
+        out = tmp_path / "trend.md"
+        proc = self._cli("perf", "report", "--out", str(out))
+        assert proc.returncode == 0
+        assert "# Perf observatory" in out.read_text()
+
+    def test_last_run_flag_is_candidate(self, tmp_path):
+        """--run promises argv order decides the candidate, even when
+        the file names sort the other way."""
+        (tmp_path / "zeta.json").write_text(
+            json.dumps(healthy_line(value=100e9))
+        )
+        (tmp_path / "alpha.json").write_text(
+            json.dumps(healthy_line(value=90e9))
+        )
+        proc = self._cli(
+            "perf", "gate", "--dir", str(tmp_path),
+            "--run", str(tmp_path / "zeta.json"),
+            "--run", str(tmp_path / "alpha.json"),
+            "--json",
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout)["candidate"] == "alpha"
+
+
+class TestWiring:
+    def test_make_check_runs_perf_gate(self):
+        mk = open(os.path.join(REPO, "Makefile")).read()
+        assert "perf-gate:" in mk
+        assert "perf gate" in mk
+        # wired into the one-command CI gate
+        check_line = [
+            l for l in mk.splitlines() if l.startswith("check:")
+        ][0]
+        assert "perf-gate" in check_line
+
+    def test_lint_covers_perfobs(self):
+        mk = open(os.path.join(REPO, "Makefile")).read()
+        assert "cyclonus_tpu/perfobs" in mk
+        # and the linters actually come back clean over it
+        for tool in ("jaxlint", "shapelint"):
+            proc = subprocess.run(
+                [sys.executable, f"tools/{tool}.py", "cyclonus_tpu/perfobs"],
+                capture_output=True,
+                text=True,
+                timeout=120,
+                cwd=REPO,
+            )
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- bench mesh_scaling per-chip field (in-process, tiny) ----------------
+
+
+class TestMeshScalingPerChip:
+    def test_rows_carry_per_chip_rate(self):
+        """mesh_scaling rows record cells_per_sec_per_chip (the stable
+        field the scaling gate reads) and the block self-identifies as
+        virtual so the sentinel reports without gating."""
+        import random as _random
+
+        import bench
+
+        pods, ns, pols = bench.build_synthetic(48, 8, _random.Random(3))
+        from cyclonus_tpu.engine import PortCase
+
+        cases = [PortCase(80, "serve-80-tcp", "TCP")]
+        detail = bench.mesh_scaling(pods, ns, pols, cases)
+        assert detail["virtual"] is True
+        assert detail["rows"], "no mesh rows produced"
+        for row in detail["rows"]:
+            assert row["cells_per_sec_per_chip"] is not None
+            assert row["cells_per_sec"] == pytest.approx(
+                row["cells_per_sec_per_chip"] * row["devices"], rel=0.01
+            )

@@ -18,8 +18,9 @@ import tunnel_wait
 
 
 class _Proc:
-    def __init__(self, stdout, rc=0):
+    def __init__(self, stdout, rc=0, stderr=""):
         self.stdout = stdout
+        self.stderr = stderr
         self.returncode = rc
 
 
@@ -112,3 +113,107 @@ class TestProbe:
                 lambda *a, _rc=rc, **kw: _Proc("", _rc),
             )
             assert tunnel_wait.probe_tunnel(0.1) is want
+
+    def test_retry_backoff_until_alive(self, monkeypatch):
+        """Two dead probes, then alive: three attempts, two jittered
+        backoff sleeps in the expected exponential envelope, every
+        attempt counted into the telemetry layer by outcome."""
+        import random
+
+        from cyclonus_tpu.telemetry.instruments import TUNNEL_PROBE_ATTEMPTS
+
+        rcs = iter([3, 3, 0])
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc("", next(rcs)),
+        )
+        sleeps = []
+        monkeypatch.setattr(tunnel_wait.time, "sleep", sleeps.append)
+        dead0 = TUNNEL_PROBE_ATTEMPTS.value(outcome="dead")
+        alive0 = TUNNEL_PROBE_ATTEMPTS.value(outcome="alive")
+        assert (
+            tunnel_wait.probe_tunnel(
+                0.1, attempts=4, backoff_s=2.0, rng=random.Random(7)
+            )
+            is True
+        )
+        assert len(sleeps) == 2
+        # full jitter: base * 2^(n-1) * [0.5, 1.5)
+        assert 1.0 <= sleeps[0] < 3.0
+        assert 2.0 <= sleeps[1] < 6.0
+        assert TUNNEL_PROBE_ATTEMPTS.value(outcome="dead") == dead0 + 2
+        assert TUNNEL_PROBE_ATTEMPTS.value(outcome="alive") == alive0 + 1
+
+    def test_retry_exhaustion_is_dead(self, monkeypatch):
+        monkeypatch.setattr(
+            tunnel_wait.subprocess, "run", lambda *a, **kw: _Proc("", 3)
+        )
+        sleeps = []
+        monkeypatch.setattr(tunnel_wait.time, "sleep", sleeps.append)
+        assert tunnel_wait.probe_tunnel(0.1, attempts=3) is False
+        assert len(sleeps) == 2  # no sleep after the final attempt
+
+
+class TestFailureClass:
+    def test_success_result_carries_ok(self, monkeypatch, tmp_path):
+        line = json.dumps(
+            {"metric": "m", "value": 123, "unit": "cells/sec"}
+        )
+        monkeypatch.setattr(
+            tunnel_wait.subprocess, "run", lambda *a, **kw: _Proc(line + "\n")
+        )
+        result = tunnel_wait.run_bench(str(tmp_path / "o.json"), bound_s=5)
+        assert result["failure_class"] == "ok"
+
+    def test_explicit_class_preserved(self, monkeypatch, tmp_path):
+        line = json.dumps(
+            {"metric": "m (FAILED)", "value": 0,
+             "error": "backend init failed after 3 attempt(s): boom",
+             "failure_class": "backend_init"}
+        )
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc(line + "\n", rc=4),
+        )
+        result = tunnel_wait.run_bench(str(tmp_path / "o.json"), bound_s=5)
+        assert result["failure_class"] == "backend_init"
+
+    def test_subprocess_bound_classifies_tunnel(self, monkeypatch, tmp_path):
+        """The outer backstop firing means bench's own watchdogs never
+        printed — the pre-import-hang signature of a dead tunnel."""
+
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="bench", timeout=1)
+
+        monkeypatch.setattr(tunnel_wait.subprocess, "run", fake_run)
+        result = tunnel_wait.run_bench(str(tmp_path / "o.json"), bound_s=5)
+        assert result["failure_class"] == "tunnel"
+
+    def test_no_json_classifies_from_stdout_tail(self, monkeypatch, tmp_path):
+        """A bench that died printing only the backend warning (the r03
+        signature) leaves its evidence on STDOUT, not in any JSON — the
+        round artifact must classify backend_init, not engine."""
+        tail = (
+            "WARNING: Platform 'axon' is experimental\n"
+            "UserWarning: Error reading cache entry: JaxRuntimeError: "
+            "UNAVAILABLE: TPU backend setup/compile error (Unavailable).\n"
+        )
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc(tail, rc=1),
+        )
+        result = tunnel_wait.run_bench(str(tmp_path / "o.json"), bound_s=5)
+        assert "no JSON" in result["error"]
+        assert result["failure_class"] == "backend_init"
+
+    def test_silent_rc124_no_json_is_tunnel(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc("WARNING: axon\n", rc=124),
+        )
+        result = tunnel_wait.run_bench(str(tmp_path / "o.json"), bound_s=5)
+        assert result["failure_class"] == "tunnel"

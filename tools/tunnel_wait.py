@@ -26,11 +26,14 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # standalone script: make cyclonus_tpu importable
+    sys.path.insert(0, REPO)
 
 PROBE_CODE = (
     "import jax; ds = jax.devices(); "
@@ -38,20 +41,58 @@ PROBE_CODE = (
     "'TPU' in str(d) for d in ds) else 3)"
 )
 
+try:
+    # the one shared backoff envelope (bench.py's init thread uses the
+    # same helper)
+    from cyclonus_tpu.utils.retry import full_jitter_pause
+except Exception:  # package unimportable: the watchdog must still run
 
-def probe_tunnel(bound_s: float = 90.0) -> bool:
-    """True iff a fresh interpreter can enumerate a TPU device within
-    bound_s.  Timeout/crash/non-TPU all count as dead."""
+    def full_jitter_pause(base_s, attempt, rng):
+        return base_s * (2 ** (attempt - 1)) * (0.5 + rng.random())
+
+
+def _count_probe(outcome: str) -> None:
+    """Feed cyclonus_tpu_tunnel_probe_attempts_total; the watchdog must
+    keep running even if the package is unimportable (e.g. moved), so a
+    failed import costs the metric, never the probe."""
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", PROBE_CODE],
-            capture_output=True,
-            timeout=bound_s,
-            cwd=REPO,
-        )
-        return proc.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+        from cyclonus_tpu.telemetry import instruments
+
+        instruments.TUNNEL_PROBE_ATTEMPTS.inc(outcome=outcome)
+    except Exception:
+        pass
+
+
+def probe_tunnel(
+    bound_s: float = 90.0,
+    attempts: int = 1,
+    backoff_s: float = 2.0,
+    rng: random.Random = None,
+) -> bool:
+    """True iff a fresh interpreter can enumerate a TPU device within
+    bound_s.  Timeout/crash/non-TPU all count as dead.  With attempts
+    > 1, dead probes retry after a full-jitter exponential backoff
+    (base * 2^(n-1) * U[0.5, 1.5) — desynced from other clients racing
+    for the same chip); every attempt lands in the
+    cyclonus_tpu_tunnel_probe_attempts_total counter by outcome."""
+    rng = rng or random.Random()
+    for attempt in range(1, max(1, attempts) + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", PROBE_CODE],
+                capture_output=True,
+                timeout=bound_s,
+                cwd=REPO,
+            )
+            outcome = "alive" if proc.returncode == 0 else "dead"
+        except (subprocess.TimeoutExpired, OSError):
+            outcome = "timeout"
+        _count_probe(outcome)
+        if outcome == "alive":
+            return True
+        if attempt <= max(1, attempts) - 1:
+            time.sleep(full_jitter_pause(backoff_s, attempt, rng))
+    return False
 
 
 def run_bench(out_path: str, bound_s: float = None) -> dict:
@@ -67,10 +108,10 @@ def run_bench(out_path: str, bound_s: float = None) -> dict:
     timestamped copy — the round's availability history."""
     if bound_s is None:
         bound_s = float(os.environ.get("BENCH_DEADLINE_S", "1500")) + 300.0
-    sys.path.insert(0, REPO)
     from bench import last_json_line
 
     rc = None
+    tail = ""
     try:
         proc = subprocess.run(
             [sys.executable, "bench.py"],
@@ -80,17 +121,38 @@ def run_bench(out_path: str, bound_s: float = None) -> dict:
             cwd=REPO,
         )
         rc = proc.returncode
+        # keep the stdout+stderr tail as classification EVIDENCE: a
+        # bench that died printing only the backend warning (r03) has
+        # its signature here, not in any JSON
+        tail = (proc.stdout or "")[-2000:] + (proc.stderr or "")[-2000:]
         result = last_json_line(proc.stdout) or {
             "error": f"bench produced no JSON (rc={rc})"
         }
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         result = {"error": f"bench exceeded the {bound_s:g}s subprocess bound"}
+        for out in (e.stdout, e.stderr):  # same evidence as the normal path
+            if not out:
+                continue
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            tail += out[-2000:]
     except json.JSONDecodeError as e:
         # a killed/crashed bench can leave a TRUNCATED final JSON line on
         # stdout; that's an error result, not a watchdog-loop killer
         result = {"error": f"bench stdout ended in unparseable JSON: {e}"}
     result["bench_rc"] = rc
     result["at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    if "failure_class" not in result:
+        # older benches (and the no-JSON/timeout paths above) don't
+        # say; classify from the evidence so the round artifact is
+        # ledger-ready without re-deriving (perfobs is the one place
+        # the classification rules live)
+        try:
+            from cyclonus_tpu.perfobs import classify
+
+            result["failure_class"] = classify(result, rc, tail)
+        except Exception:
+            pass
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     ok = "error" not in result and result.get("value", 0) > 0
     target = out_path if ok else out_path.replace(".json", ".failed.json")
@@ -117,6 +179,15 @@ def main() -> int:
     ap.add_argument("--out", default="artifacts/bench_watchdog_latest.json")
     ap.add_argument("--probe-bound", type=float, default=90.0)
     ap.add_argument(
+        "--probe-retries", type=int, default=3,
+        help="probe attempts per cycle before calling the tunnel dead "
+        "(jittered exponential backoff between them; default 3)",
+    )
+    ap.add_argument(
+        "--probe-backoff", type=float, default=2.0,
+        help="backoff base seconds between probe attempts (default 2)",
+    )
+    ap.add_argument(
         "--rebench-every", type=float, default=3600.0,
         help="re-run the bench if the last success is older than this "
         "(a fresh artifact beats a stale one; default 1h)",
@@ -127,7 +198,11 @@ def main() -> int:
     last_success = 0.0
     benched_ok = None  # tri-state for --once: None = bench never ran
     while True:
-        alive = probe_tunnel(args.probe_bound)
+        alive = probe_tunnel(
+            args.probe_bound,
+            attempts=args.probe_retries,
+            backoff_s=args.probe_backoff,
+        )
         now = time.strftime("%H:%M:%S")
         if alive and (time.time() - last_success) >= args.rebench_every:
             print(f"[{now}] tunnel ALIVE -> running bench", flush=True)
