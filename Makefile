@@ -39,10 +39,21 @@ shapelint:
 perf-gate:
 	python -m cyclonus_tpu perf gate
 
+# the compressed-path parity gate: the equivalence-class grid
+# compression forced on AND the runtime tensor contracts live
+# (CYCLONUS_SHAPE_CHECK=1), through the full parity + class suites —
+# compressed vs dense vs scalar oracle stays bit-identical with every
+# class tensor validated at construction (docs/DESIGN.md "Grid
+# compression")
+parity-compressed:
+	CYCLONUS_SHAPE_CHECK=1 CYCLONUS_CLASS_COMPRESS=1 JAX_PLATFORMS=cpu \
+	  python -m pytest tests/test_engine_parity.py \
+	  tests/test_engine_classes.py -q
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
 # then run the suite on a CPU 8-device mesh
-check: vet lint perf-gate
+check: vet lint perf-gate parity-compressed
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -78,4 +89,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz race bench fmt vet lint shapelint perf-gate cyclonus docker
+.PHONY: test check conformance fuzz race bench fmt vet lint shapelint perf-gate parity-compressed cyclonus docker

@@ -28,7 +28,15 @@ the 2M envelope), BENCH_INIT_DEADLINE_S (backend-attach bound, default
 150, 0=off), BENCH_INIT_RETRIES / BENCH_INIT_BACKOFF_S (attach attempts
 and jittered-backoff base inside the overlapped init thread; attempts
 are counted into telemetry and reported in detail.cold_start),
-BENCH_MESH_PODS / BENCH_MESH_POLICIES (mesh_scaling problem size).
+BENCH_MESH_PODS / BENCH_MESH_POLICIES (mesh_scaling problem size),
+BENCH_MEGA (auto: the 1M-pod equivalence-class compression case runs on
+TPU only; 1/0 force/skip), BENCH_MEGA_PODS / BENCH_MEGA_POLICIES /
+BENCH_MEGA_NS (its problem shape — few namespaces by design: the case
+models the "thousands of pods, a handful of label shapes" regime the
+compression exists for; detail.mega_class.class_compression records
+pods/classes/ratio/gather_s).  Every line also records the HEADLINE
+engine's detail.class_compression (CYCLONUS_CLASS_COMPRESS governs the
+engine-side path selection).
 
 On any failure — watchdog expiry, backend init timeout/error, or crash —
 the bench still prints one parseable JSON line with an "error" field, a
@@ -168,6 +176,7 @@ def _cpu_fallback_leg() -> dict:
                 "BENCH_FALLBACK_POLICIES", "256"
             ),
             "BENCH_MESH": "0",
+            "BENCH_MEGA": "0",
             "BENCH_PARITY": "0",
             "BENCH_SAMPLE": "5",
             "BENCH_DEADLINE_S": "240",
@@ -247,7 +256,9 @@ def _start_watchdog(done: "threading.Event", deadline_s: float, stall_s: float):
     return t
 
 
-def build_synthetic(n_pods: int, n_policies: int, rng: random.Random):
+def build_synthetic(
+    n_pods: int, n_policies: int, rng: random.Random, n_ns: int = None
+):
     from cyclonus_tpu.kube.netpol import (
         IntOrString,
         LabelSelector,
@@ -260,7 +271,7 @@ def build_synthetic(n_pods: int, n_policies: int, rng: random.Random):
         IPBlock,
     )
 
-    n_ns = max(2, n_pods // 250)
+    n_ns = n_ns or max(2, n_pods // 250)
     namespaces = {
         f"ns{i}": {"ns": f"ns{i}", "team": f"team{i % 7}"} for i in range(n_ns)
     }
@@ -644,6 +655,97 @@ def mesh_scaling(pods, namespaces, policies, cases) -> dict:
         "conserved work; per-eval collective is one ~KB all-gather",
         "rows": rows,
     }
+
+
+def mega_class_case(cases) -> dict:
+    """The 1M-pod synthetic-cluster case (ROADMAP item 2): a cluster an
+    order of magnitude past the headline shape, evaluable on one chip
+    ONLY because equivalence-class compression collapses the pod axis —
+    the dense 2e12-cell grid would blow both the HBM budget and the
+    bench deadline.  The cluster models the regime the compression
+    exists for (many pods, few distinct label shapes: BENCH_MEGA_NS
+    namespaces over BENCH_MEGA_PODS pods), and the case records
+    detail.mega_class.class_compression = {pods, classes, ratio,
+    gather_s} plus the three safety legs: the HBM-budget eligibility
+    check, a scalar-oracle pairs spot check, and the oracle-backed
+    class-reduction audit (analysis.audit_class_reduction)."""
+    from cyclonus_tpu import analysis
+    from cyclonus_tpu.engine import TpuPolicyEngine
+    from cyclonus_tpu.matcher import build_network_policies
+
+    n_pods = int(os.environ.get("BENCH_MEGA_PODS", "1000000"))
+    n_pols = int(os.environ.get("BENCH_MEGA_POLICIES", "2000"))
+    n_ns = int(os.environ.get("BENCH_MEGA_NS", "512"))
+    rng = random.Random(20260803)
+    pods, namespaces, policies = build_synthetic(
+        n_pods, n_pols, rng, n_ns=n_ns
+    )
+    t0 = time.time()
+    policy = build_network_policies(True, policies)
+    t_build = time.time() - t0
+    t0 = time.time()
+    engine = TpuPolicyEngine(policy, pods, namespaces)
+    t_encode = time.time() - t0
+    out = {
+        "pods": n_pods,
+        "policies": n_pols,
+        "namespaces": n_ns,
+        "build_s": round(t_build, 3),
+        "encode_s": round(t_encode, 3),
+        "class_compression": engine.class_compression_stats(),
+    }
+    if not out["class_compression"]["active"]:
+        out["skipped"] = "class compression inactive for this shape"
+        return out
+    # the acceptance gate: the compressed path's whole device footprint
+    # (aux/index tensors + class precompute + row sums) must fit the
+    # CYCLONUS_SLAB_MAX_BYTES HBM budget
+    out["hbm_budget_ok"] = engine._class_counts_eligible(len(cases))
+    if not out["hbm_budget_ok"]:
+        # do NOT fall through: evaluate_grid_counts would route to the
+        # dense kernels, whose [T, N, Q] precompute at this shape is the
+        # exact HBM blow-up the compression exists to avoid — a clean
+        # skip beats an infra-looking timeout/OOM
+        out["skipped"] = (
+            "compressed counts exceed CYCLONUS_SLAB_MAX_BYTES; dense "
+            "fallback is not viable at this shape"
+        )
+        return out
+    t0 = time.time()
+    counts = engine.evaluate_grid_counts(cases)
+    out["warmup_s"] = round(time.time() - t0, 3)
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        counts = engine.evaluate_grid_counts(cases)
+        times.append(time.time() - t0)
+    out["eval_s"] = round(min(times), 4)
+    out["cells"] = counts["cells"]
+    out["cells_per_sec"] = round(counts["cells"] / min(times))
+    out["allow_rate"] = round(counts["combined"] / max(counts["cells"], 1), 4)
+    # refresh: the evals above recorded the broadcast-back epilogue
+    out["class_compression"] = engine.class_compression_stats()
+    # scalar-oracle spot check through the pairs kernel (no N x N grid)
+    n_samples = int(os.environ.get("BENCH_MEGA_SAMPLE", "10"))
+    spot_check_pairs(engine, policy, pods, namespaces, cases, n_samples, rng)
+    out["parity_spot_checks"] = n_samples
+    # the class reduction itself, oracle-verified on sampled co-classed
+    # pods (a violation raises out of the bench as a correctness failure)
+    audit = analysis.audit_class_reduction(
+        policy, pods, namespaces, cases, engine.pod_classes(),
+        max_classes=int(os.environ.get("BENCH_MEGA_AUDIT_CLASSES", "4")),
+        peers_per_class=4, rng=rng,
+    )
+    out["audit"] = {
+        "checked_classes": audit["checked_classes"],
+        "checked_cells": audit["checked_cells"],
+        "ok": audit["ok"],
+    }
+    if not audit["ok"]:
+        raise AssertionError(
+            f"CLASS REDUCTION AUDIT FAILURE: {audit['violations'][:3]}"
+        )
+    return out
 
 
 def main():
@@ -1034,6 +1136,36 @@ def _bench(done):
             if counts_backend == "pallas"
             else None
         )
+        _enter_phase("mega_class")
+        mega_detail = None
+        mega_mode = os.environ.get("BENCH_MEGA", "auto")
+        if mega_mode == "auto":
+            import jax
+
+            mega_on = jax.default_backend() == "tpu"
+        else:
+            mega_on = mega_mode == "1"
+        if mega_on:
+            from cyclonus_tpu.utils.bounded import run_bounded
+
+            # BOUNDED like the sharded_1dev leg: the mega case compiles
+            # fresh programs after the headline is measured — a wedged
+            # compile must cost only this detail block.  Correctness
+            # failures (oracle parity / class audit) re-raise loudly.
+            _stall_env = float(os.environ.get("BENCH_STALL_S", "300"))
+            _bound = (
+                min(240.0, _stall_env * 0.8) if _stall_env > 0 else 600.0
+            )
+            status, value = run_bounded(lambda: mega_class_case(cases), _bound)
+            if status == "ok":
+                mega_detail = value
+            elif status == "error" and isinstance(value, AssertionError):
+                raise value
+            else:
+                mega_detail = {
+                    "status": status,
+                    "error": None if status == "timeout" else repr(value),
+                }
         _enter_phase("mesh_scaling")
         mesh_detail = None
         if os.environ.get("BENCH_MESH", "1") == "1":
@@ -1121,6 +1253,16 @@ def _bench(done):
                         # (the compile path multi-chip would use), counts
                         # pinned to the single-device kernel
                         "sharded_pallas_1dev": sharded_1dev,
+                        # equivalence-class grid compression of the
+                        # HEADLINE engine: pods/classes/ratio + the
+                        # broadcast-back epilogue seconds (perfobs reads
+                        # detail.class_compression.ratio on every line)
+                        "class_compression": engine.class_compression_stats(),
+                        # the 1M-pod synthetic case (BENCH_MEGA): the
+                        # compression-only shape, with its own
+                        # class_compression block, HBM-budget check,
+                        # oracle spot parity, and class-reduction audit
+                        "mega_class": mega_detail,
                         # sharded/ring on the 8-virtual-device CPU mesh
                         # (BENCH_MESH=0 to skip): shard shapes + counts
                         # pinned; flat wall-clock = conserved work
@@ -1195,6 +1337,7 @@ def _bench(done):
                     "eval_s": round(t_eval, 4),
                     "allow_rate": round(allow_rate, 4),
                     "parity_spot_checks": n_samples,
+                    "class_compression": engine.class_compression_stats(),
                     "telemetry": telemetry.snapshot(),
                     "trace": _trace_detail(trace_dir),
                 },

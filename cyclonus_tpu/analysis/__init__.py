@@ -16,14 +16,19 @@ tensors plus a handful of boolean reductions:
                         subset before it reaches the user
   * cluster (cluster.py) — derive port cases / synthesize a
                         representative cluster from the policies alone
+  * classes (classes.py) — oracle-backed audit of the equivalence-class
+                        grid compression: co-classed pods must get
+                        identical scalar verdicts against every peer
 """
 
 from .audit import AuditFinding, AuditReport, RuleRef, audit_policy_set
+from .classes import audit_class_reduction
 from .cluster import derive_port_cases, synthesize_cluster
 from .diff import DiffCell, DiffReport, diff_policy_sets
 from .oracle import policy_without_rule
 
 __all__ = [
+    "audit_class_reduction",
     "AuditFinding",
     "AuditReport",
     "RuleRef",

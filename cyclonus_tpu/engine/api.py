@@ -21,7 +21,15 @@ from ..matcher.core import Policy
 from ..telemetry import instruments as ti
 from ..utils import guards
 from ..utils.tracing import phase
-from .encoding import PEER_IP, PolicyEncoding, _DirectionEncoding, encode_policy
+from .encoding import (
+    PEER_IP,
+    PolicyEncoding,
+    _DirectionEncoding,
+    compress_rule_axes,
+    compute_pod_classes,
+    encode_policy,
+    gather_class_pod_rows,
+)
 
 
 @dataclass(frozen=True)
@@ -530,6 +538,46 @@ def _compaction_enabled(tensors: Dict) -> bool:
     return True
 
 
+#: below this pod count the auto mode leaves the legacy paths untouched:
+#: the compressed path's win is quadratic in cluster size, and tiny
+#: clusters are where the per-engine second tensor set costs most
+#: relative to the work saved (CYCLONUS_CLASS_MIN_PODS overrides)
+_CLASS_AUTO_MIN_PODS = 2048
+#: the weighted-count split keeps every device-side partial an exact f32
+#: integer only while row sums stay below 2^24 (tiled.py class counts
+#: design note) — larger clusters bypass compression entirely
+_CLASS_MAX_PODS_EXACT = 1 << 24
+
+
+def _class_compress_mode() -> str:
+    """CYCLONUS_CLASS_COMPRESS: "auto" (default — engage above the pod
+    floor when the class reduction is real), "1" (force, any size),
+    "0" (off, incl. the rule-axis partition compression)."""
+    import os
+
+    return os.environ.get("CYCLONUS_CLASS_COMPRESS", "auto").lower()
+
+
+def _class_auto_min_pods() -> int:
+    import os
+
+    try:
+        return int(
+            os.environ.get("CYCLONUS_CLASS_MIN_PODS", str(_CLASS_AUTO_MIN_PODS))
+        )
+    except ValueError:
+        return _CLASS_AUTO_MIN_PODS
+
+
+def _np_leaves(tree):
+    """Flat iterator over the numpy leaves of a nested tensor dict."""
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _np_leaves(v)
+    elif isinstance(tree, np.ndarray):
+        yield tree
+
+
 def _pack_tensors(tree):
     """Pack a numpy pytree into one int32 buffer + an unpack function.
 
@@ -640,10 +688,53 @@ class TpuPolicyEngine:
                     self._tensors = _compact_dead_targets(
                         self._tensors, selpod=self._selpod_prebucket
                     )
+            # equivalence-class grid compression (docs/DESIGN.md "Grid
+            # compression"): tuple-space partition compression of the
+            # rule axes is exact and cheap, so it applies whenever
+            # compression isn't disabled outright; the pod-class state
+            # additionally needs the host selector pass and a real
+            # reduction (auto mode) before paying for a second tensor set
+            self._partition_stats = None
+            self._class_state = None
+            mode = _class_compress_mode()
+            if mode != "0":
+                with phase("engine.partition"):
+                    pstats = {}
+                    for direction in ("ingress", "egress"):
+                        nd, pstats[direction] = compress_rule_axes(
+                            self._tensors[direction]
+                        )
+                        self._tensors[direction] = nd
+                    self._partition_stats = pstats
+                self._maybe_build_class_state(mode)
             self._tensors = _bucket_tensors(_sort_targets_by_ns(self._tensors))
+            if self._class_state is not None:
+                st = self._class_state
+                st["ctensors"] = _bucket_tensors(
+                    _sort_targets_by_ns(st.pop("ctensors_raw"))
+                )
+                # the gather/index tensors the compressed path pins on
+                # device: class map + weights + the compressed tensor
+                # buffer — counted against CYCLONUS_SLAB_MAX_BYTES by
+                # the slab plan and the compressed-counts eligibility
+                cb = int(st["ctensors"]["pod_ns_id"].shape[0])
+                st["aux_bytes"] = int(
+                    self.encoding.cluster.n_pods * 4
+                    + cb * 4
+                    + sum(a.nbytes for a in _np_leaves(st["ctensors"]))
+                )
+                ti.CLASS_AUX_BYTES.set(st["aux_bytes"])
         self._device_tensors = None  # lazily device_put once
         self._packed_buf = None  # single-buffer device copy (all paths)
         self._unpack = None
+        # compressed-path device state (all lazy; None when no class
+        # state): packed class-representative buffer + unpacked pytree,
+        # the pod->class gather map, and the fused grid+gather program
+        self._class_packed_buf = None
+        self._class_unpack = None
+        self._class_device_tensors = None
+        self._class_of_dev = None
+        self._class_grid_jit = None
         self._pod_perm_dev = None  # ns-order pod permutation (counts path)
         self._pod_perm_host = None
         self._slab_plan_state = "unset"  # -> None | {direction: t0 dev array}
@@ -737,6 +828,273 @@ class TpuPolicyEngine:
                 tensors[direction]["host_ip_match"] = match
         return tensors
 
+    # --- equivalence-class grid compression ------------------------------
+
+    def _maybe_build_class_state(self, mode: str) -> None:
+        """Bucket pods into label-equivalence classes and keep the
+        compressed tensor set when compression is forced (mode "1") or
+        worth it (auto: above the pod floor with a real reduction).
+        Reuses the SAME host selector pass dead-target compaction paid
+        for; when compaction's work budget skipped that pass, auto mode
+        skips classes too (forcing recomputes it)."""
+        n = self.encoding.cluster.n_pods
+        if n == 0 or n >= _CLASS_MAX_PODS_EXACT:
+            return
+        if mode != "1" and n < _class_auto_min_pods():
+            return
+        selpod = self._selpod_prebucket
+        if selpod is None:
+            if mode != "1":
+                return
+            selpod = self._selpod_prebucket = _selector_pod_matches_host(
+                self._tensors
+            )
+        with phase("engine.classify"):
+            pc = compute_pod_classes(self._tensors, selpod)
+        if mode != "1" and pc.n_classes > int(0.9 * n):
+            return  # no real reduction: the second tensor set isn't worth it
+        self._class_state = {
+            "classes": pc,
+            "ratio": n / max(pc.n_classes, 1),
+            "ctensors_raw": gather_class_pod_rows(self._tensors, pc.class_rep),
+            "aux_bytes": 0,  # finalized after bucketing (engine __init__)
+            "last_gather_s": None,
+        }
+        ti.CLASS_PODS.set(n)
+        ti.CLASS_COUNT.set(pc.n_classes)
+        ti.CLASS_RATIO.set(self._class_state["ratio"])
+
+    def pod_classes(self):
+        """The PodClasses of the active compression state, or None when
+        compression is off / bypassed for this engine (analysis's
+        audit_class_reduction and bench.py consume this)."""
+        st = self._class_state
+        return st["classes"] if st is not None else None
+
+    def _class_aux_bytes(self) -> int:
+        """Device bytes of the compression's gather/index tensors —
+        charged against CYCLONUS_SLAB_MAX_BYTES wherever that budget is
+        gated, so the compressed path can never over-commit the HBM it
+        exists to save."""
+        st = self._class_state
+        return int(st["aux_bytes"]) if st is not None else 0
+
+    def class_compression_stats(self) -> Dict:
+        """The grid-compression summary bench.py records as
+        detail.class_compression: pods, classes, ratio, the last
+        broadcast-back epilogue seconds, and the rule-axis partition
+        stats."""
+        n = self.encoding.cluster.n_pods
+        st = self._class_state
+        if st is None:
+            return {
+                "active": False,
+                "pods": n,
+                "classes": None,
+                "ratio": None,
+                "gather_s": None,
+                "partitions": self._partition_stats,
+            }
+        pc = st["classes"]
+        return {
+            "active": True,
+            "pods": n,
+            "classes": pc.n_classes,
+            "ratio": round(st["ratio"], 4),
+            "gather_s": st["last_gather_s"],
+            "signature_bytes": pc.signature_bytes,
+            "aux_bytes": st["aux_bytes"],
+            "partitions": self._partition_stats,
+        }
+
+    def _ctensors_with_cases(
+        self, cases: Sequence[PortCase], device: bool = False
+    ) -> Dict:
+        """Compressed-tensor twin of _tensors_with_cases: the class-
+        representative tensor set + port-case arrays, optionally through
+        its own single-buffer device transfer."""
+        q_port, q_name, q_proto = self._port_case_arrays(cases)
+        st = self._class_state
+        if device:
+            import jax
+
+            if self._class_device_tensors is None:
+                buf = self._packed_transfer(
+                    "_class_packed_buf", "_class_unpack", st["ctensors"]
+                )
+                self._class_device_tensors = jax.jit(self._class_unpack)(buf)
+            tensors = dict(self._class_device_tensors)
+        else:
+            tensors = dict(st["ctensors"])
+        tensors["q_port"] = q_port
+        tensors["q_name"] = q_name
+        tensors["q_proto"] = q_proto
+        return tensors
+
+    def _class_counts_eligible(self, q: int) -> bool:
+        """The compressed counts route must itself fit the HBM budget it
+        protects: aux/index tensors + the class precompute + row sums,
+        all estimated host-side before any dispatch."""
+        st = self._class_state
+        if st is None:
+            return False
+        import os
+
+        budget = int(
+            os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30))
+        )
+        ct = st["ctensors"]
+        cb = int(ct["pod_ns_id"].shape[0])
+        t = sum(
+            int(ct[d]["target_ns"].shape[0]) for d in ("ingress", "egress")
+        )
+        # tallow bf16 [T, Cb, Q] per direction + tmatch + f32 row sums
+        est = st["aux_bytes"] + t * cb * (2 * q + 1) + cb * q * 12
+        return est <= budget
+
+    def _counts_classes(
+        self,
+        cases: Sequence[PortCase],
+        n: int,
+        *,
+        sharded: bool = False,
+        block: int = 1024,
+        mesh=None,
+    ) -> Dict[str, int]:
+        """Compressed counts: class-grid weighted row sums on device
+        (single-device, or class-axis-sharded over `mesh`), exact int64
+        class-size weighting on host (tiled.py).  One epilogue for both
+        routes so the stats/telemetry can never diverge."""
+        st = self._class_state
+        pc = st["classes"]
+        if sharded:
+            from .tiled import evaluate_grid_counts_classes_sharded
+
+            counts, gather_s = evaluate_grid_counts_classes_sharded(
+                self._ctensors_with_cases(cases),
+                pc.n_classes,
+                pc.class_size,
+                n,
+                block=block,
+                mesh=mesh,
+            )
+        else:
+            from .tiled import evaluate_grid_counts_classes
+
+            counts, gather_s = evaluate_grid_counts_classes(
+                self._ctensors_with_cases(cases, device=True),
+                pc.n_classes,
+                pc.class_size,
+                n,
+            )
+        st["last_gather_s"] = gather_s
+        ti.CLASS_GATHER_SECONDS.set(gather_s)
+        ti.CLASS_EVALS.inc(path="sharded" if sharded else "counts")
+        return counts
+
+    def _evaluate_grid_classes(self, cases: Sequence[PortCase]) -> GridVerdict:
+        """Compressed grid path: evaluate the C x C x Q class grid and
+        broadcast back to pod axes with the int32 gather epilogue —
+        kernel + gather trace into ONE jit, so the path keeps the dense
+        path's single-execution property."""
+        import jax
+
+        from .kernel import evaluate_grid_kernel, gather_class_grids
+
+        st = self._class_state
+        n = self.encoding.cluster.n_pods
+        with ti.eval_flight(
+            "grid.classes",
+            n,
+            len(cases),
+            classes=st["classes"].n_classes,
+            dispatch_only=True,
+        ):
+            tensors = self._ctensors_with_cases(cases, device=True)
+            if self._class_of_dev is None:
+                with phase("engine.device_put"):
+                    self._class_of_dev = jax.device_put(
+                        st["classes"].class_of_pod
+                    )
+            if self._class_grid_jit is None:
+                self._class_grid_jit = jax.jit(
+                    lambda t, co: gather_class_grids(
+                        evaluate_grid_kernel(t), co
+                    )
+                )
+            with phase("engine.dispatch"):
+                out = self._class_grid_jit(tensors, self._class_of_dev)
+            ti.CLASS_EVALS.inc(path="grid")
+        return GridVerdict(
+            self.pod_keys,
+            list(cases),
+            out["ingress"],
+            out["egress"],
+            out["combined"],
+        )
+
+    def _evaluate_grid_sharded_classes(
+        self, cases: Sequence[PortCase], mesh
+    ) -> GridVerdict:
+        """Compressed mesh path: the shard_map program runs over the
+        class axis; the gather epilogue broadcasts back to pod axes
+        device-side (sharded.evaluate_class_grid_sharded)."""
+        import jax.numpy as jnp
+
+        from .sharded import evaluate_class_grid_sharded
+
+        st = self._class_state
+        pc = st["classes"]
+        tensors = self._ctensors_with_cases(cases)
+        with phase("engine.dispatch_sharded"):
+            ingress, egress, combined = evaluate_class_grid_sharded(
+                tensors, pc.n_classes, pc.class_of_pod, mesh=mesh
+            )
+        ti.CLASS_EVALS.inc(path="sharded")
+        return GridVerdict(
+            self.pod_keys,
+            list(cases),
+            jnp.moveaxis(ingress, -1, 0),
+            jnp.moveaxis(egress, -1, 0),
+            jnp.moveaxis(combined, -1, 0),
+        )
+
+    def _pipelined_classes(self, cases: Sequence[PortCase], reps: int):
+        """Compressed twin of the pipelined steady-state measurement:
+        `reps` async dispatches of the class row-sum program, one
+        readback, the same exact host finish."""
+        import time as _time
+
+        from .tiled import (
+            _class_rowsums_kernel,
+            class_counts_finish,
+            class_rowsums_plan,
+        )
+
+        st = self._class_state
+        pc = st["classes"]
+        n = self.encoding.cluster.n_pods
+        tensors = self._ctensors_with_cases(cases, device=True)
+        w, block, n_tiles = class_rowsums_plan(
+            tensors, pc.n_classes, pc.class_size
+        )
+        out = _class_rowsums_kernel(tensors, w, block, n_tiles)
+        np.asarray(out)  # warm barrier
+        t0 = _time.perf_counter()
+        outs = [
+            _class_rowsums_kernel(tensors, w, block, n_tiles)
+            for _ in range(reps)
+        ]
+        rs = np.asarray(outs[-1])  # in-order stream: one barrier
+        dt = (_time.perf_counter() - t0) / reps
+        counts = class_counts_finish(
+            rs, pc.class_size, pc.n_classes, len(cases), n
+        )
+        if dt > 0:
+            ti.EVAL_DEVICE_SECONDS.set(dt)
+            ti.EVAL_PIPELINED_CELLS_PER_SEC.set(counts["cells"] / dt)
+        return dt, counts
+
     def _port_case_arrays(self, cases: Sequence[PortCase]):
         vocab = self.encoding.cluster.vocab
         q_port = np.array([c.port for c in cases], dtype=np.int32)  # shape: (Q,) int32
@@ -770,6 +1128,8 @@ class TpuPolicyEngine:
             n = self.encoding.cluster.n_pods
             empty = np.zeros((0, n, n), dtype=bool)
             return GridVerdict(self.pod_keys, [], empty, empty.copy(), empty.copy())
+        if self._class_state is not None:
+            return self._evaluate_grid_classes(cases)
         n = self.encoding.cluster.n_pods
         with ti.eval_flight("grid", n, len(cases), dispatch_only=True):
             tensors = self._tensors_with_cases(cases, device=True)
@@ -853,6 +1213,14 @@ class TpuPolicyEngine:
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        if self._class_state is not None and self._class_counts_eligible(
+            len(cases)
+        ):
+            # compressed route (either backend: identical by construction;
+            # the class grid is small enough that the XLA tile loop is
+            # already device-bound) — bypassed when the estimate would
+            # blow the HBM budget, falling back to the dense kernels
+            return self._counts_classes(cases, n)
         if backend == "pallas":
             return self._counts_pallas_packed(cases, n)
         from .tiled import evaluate_grid_counts
@@ -936,11 +1304,15 @@ class TpuPolicyEngine:
         itemsize = 2 if _resolve_operand_dtype(None) == "bf16" else 1
         bytes_per_case = n_tiles * slab_w_aug() * n_b * itemsize
         budget = int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
+        # the class-compression gather/index tensors share the budget:
+        # without counting them here the slab + aux could jointly
+        # over-commit HBM exactly when compression is supposed to save it
+        aux = self._class_aux_bytes()
         # watermark gauges: planned slab HBM (q=2 budget point) vs the
         # budget — set before the gate so a rejected plan is visible too
-        ti.SLAB_HBM_BYTES.set(2 * bytes_per_case)
+        ti.SLAB_HBM_BYTES.set(2 * bytes_per_case + aux)
         ti.SLAB_HBM_BUDGET_BYTES.set(budget)
-        if 2 * bytes_per_case > budget:
+        if 2 * bytes_per_case + aux > budget:
             return None
         self._slab_bytes_per_case = bytes_per_case
         self._slab_budget = budget
@@ -1405,7 +1777,9 @@ class TpuPolicyEngine:
         slab = self._slab_plan_state
         slab_ok = isinstance(slab, dict) and (
             self._slab_bytes_per_case is None
-            or len(cases) * self._slab_bytes_per_case <= self._slab_budget
+            or len(cases) * self._slab_bytes_per_case
+            + self._class_aux_bytes()
+            <= self._slab_budget
         )
         with self._slab_lock:
             choice = self._slab_choice
@@ -1496,6 +1870,17 @@ class TpuPolicyEngine:
         queue and would pollute a number recorded as stable)."""
         import time as _time
 
+        if self._class_state is not None and self._class_counts_eligible(
+            len(cases)
+        ):
+            # the orphan gate applies here too: a cancelled autotune
+            # candidate (possible when an earlier INELIGIBLE case set
+            # ran the dense pallas path) shares the device queue and
+            # would pollute the compressed timing just the same
+            self._drain_autotune_orphan()
+            if self._autotune_orphan is not None:
+                return None
+            return self._pipelined_classes(cases, reps)
         key, _slab_ok, slab_args, _qs, _choice = self._steady_state_args(cases)
         if self._pre_cache is None or self._pre_cache[0] != key:
             return None
@@ -1536,6 +1921,12 @@ class TpuPolicyEngine:
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        if self._class_state is not None and self._class_counts_eligible(
+            len(cases)
+        ):
+            return self._counts_classes(
+                cases, n, sharded=True, block=block, mesh=mesh
+            )
         from .tiled import evaluate_grid_counts_sharded
 
         return evaluate_grid_counts_sharded(
@@ -1661,6 +2052,8 @@ class TpuPolicyEngine:
         self._check_ips()
         if not cases:
             return self.evaluate_grid(cases)
+        if self._class_state is not None:
+            return self._evaluate_grid_sharded_classes(cases, mesh)
         tensors = self._tensors_with_cases(cases)
         import jax.numpy as jnp
 

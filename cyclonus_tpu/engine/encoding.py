@@ -688,6 +688,255 @@ class PolicyEncoding:
     n_selectors: int
 
 
+# --- equivalence-class grid compression ----------------------------------
+#
+# The verdict of pod n is a pure function of what the RESOLVED MATCHER SET
+# can observe about n (kernel.py direction_precompute, term by term):
+#   * tmatch:     target_ns == pod_ns_id[n]  AND  selpod[target_sel, n]
+#   * pod peers:  ns kind (EXACT compares pod_ns_id; SELECTOR goes through
+#                 selns[*, pod_ns_id[n]]) and selpod[peer_pod_sel, n]
+#   * ip peers:   pod_ip_valid-masked CIDR membership per distinct
+#                 (base, mask, excepts) row; host-evaluated v6 rows are a
+#                 per-pod bool column of their own
+# so the tuple (ns id, selector-match column, CIDR-membership bits,
+# host-ip columns) is a COMPLETE signature: pods sharing it are
+# indistinguishable to every rule and must receive identical verdict rows
+# AND columns.  compute_pod_classes buckets pods by that signature; the
+# evaluators then run the unique (src-class x dst-class x port) grid and
+# broadcast back with an int32 gather (kernel.gather_class_grids) or an
+# exact class-size weighting (tiled.evaluate_grid_counts_classes).
+# Soundness is pinned three ways: the property suite hashes signatures
+# against scalar-oracle verdict rows, the parity suite runs compressed vs
+# dense vs oracle bit-identical, and analysis.audit_class_reduction
+# oracle-checks co-classed pods at scale.
+
+
+@contracts.checked
+@dataclass
+class PodClasses:
+    """Label-equivalence classes over the pod axis.
+
+    Tensor contracts: N pods, C classes.  class_of_pod maps pod row ->
+    class id; class_rep is the first member (the row whose tensors stand
+    in for the whole class); class_size the member count (the exact
+    weight of a class cell when counts broadcast back to the pod grid).
+    Validated on construction under CYCLONUS_SHAPE_CHECK=1."""
+
+    n_pods: int
+    n_classes: int
+    class_of_pod: np.ndarray = contracts.tensor("(N,) int32")
+    class_rep: np.ndarray = contracts.tensor("(C,) int32")
+    class_size: np.ndarray = contracts.tensor("(C,) int32")
+    # bytes per pod of the signature the classes were derived from
+    signature_bytes: int = 0
+
+
+def _ip_signature_bits(tensors: Dict) -> Optional[np.ndarray]:
+    """[N, ceil(B/8)] uint8 packed per-pod IP-observability bits, or None
+    when no rule observes pod IPs.
+
+    One bit per DISTINCT (base, mask, sorted excepts) IPv4 ip-peer row
+    across both directions — the same membership term the kernel
+    computes (in_cidr & ~in_except, both pod_ip_valid-masked) — plus one
+    bit per host-evaluated (IPv6/mixed) row's match column, plus the
+    validity bit itself.  Deduping rows first keeps the bit count at the
+    number of distinct CIDR shapes, not the raw peer count."""
+    pod_ip = tensors["pod_ip"]  # shape: (N,) uint32; sentinel: 0=invalid; mask: pod_ip_valid
+    pod_ip_valid = tensors["pod_ip_valid"]  # shape: (N,) bool
+    n = int(pod_ip.shape[0])
+    specs: Dict[Tuple[int, int, Tuple[Tuple[int, int], ...]], None] = {}
+    host_cols: List[np.ndarray] = []
+    for direction in ("ingress", "egress"):
+        d = tensors[direction]
+        rows = np.flatnonzero((d["peer_kind"] == PEER_IP) & d["ip_is_v4"])
+        for r in rows:
+            exs = tuple(
+                sorted(
+                    (int(d["ex_base"][r, j]), int(d["ex_mask"][r, j]))
+                    for j in np.flatnonzero(d["ex_valid"][r])
+                )
+            )
+            specs.setdefault(
+                (int(d["ip_base"][r]), int(d["ip_mask"][r]), exs), None
+            )
+        if "host_ip_mask" in d:
+            for r in np.flatnonzero(d["host_ip_mask"]):
+                host_cols.append(np.asarray(d["host_ip_match"][r], dtype=bool))
+    if not specs and not host_cols:
+        return None
+    bits = np.zeros((len(specs) + len(host_cols) + 1, n), dtype=bool)
+    for i, (base, mask, exs) in enumerate(specs):
+        # mirrors kernel.direction_precompute: both the CIDR term and
+        # every except term consult pod_ip_valid (SC003 on pod_ip)
+        m = pod_ip_valid & ((pod_ip & np.uint32(mask)) == np.uint32(base))
+        for eb, em in exs:
+            m &= ~(pod_ip_valid & ((pod_ip & np.uint32(em)) == np.uint32(eb)))
+        bits[i] = m
+    for j, col in enumerate(host_cols):
+        bits[len(specs) + j] = col
+    bits[-1] = pod_ip_valid
+    return np.packbits(bits, axis=0).T  # [N, ceil(B/8)]
+
+
+def compute_pod_classes(tensors: Dict, selpod: np.ndarray) -> PodClasses:
+    """Bucket pods into label-equivalence classes.
+
+    `tensors` is the engine tensor dict BEFORE shape bucketing (real pod
+    rows only); `selpod` the [S, N] host selector-match matrix over the
+    same rows (api._selector_pod_matches_host — the identical pass that
+    feeds dead-target compaction).  Pure numpy: one packed signature
+    matrix, one np.unique over its void view."""
+    n = int(tensors["pod_ns_id"].shape[0])
+    if n == 0:
+        z = np.zeros((0,), dtype=np.int32)
+        return PodClasses(
+            n_pods=0, n_classes=0, class_of_pod=z,
+            class_rep=z.copy(), class_size=z.copy(),
+        )
+    blocks = [
+        np.ascontiguousarray(
+            tensors["pod_ns_id"].astype(np.int32, copy=False).reshape(n, 1)
+        ).view(np.uint8).reshape(n, 4)
+    ]
+    if selpod.shape[0]:
+        if selpod.shape[1] != n:
+            raise ValueError(
+                f"selpod covers {selpod.shape[1]} pods but tensors hold {n}"
+            )
+        blocks.append(np.packbits(selpod, axis=0).T)  # [N, ceil(S/8)]
+    ip_bits = _ip_signature_bits(tensors)
+    if ip_bits is not None:
+        blocks.append(ip_bits)
+    buf = np.ascontiguousarray(np.concatenate(blocks, axis=1))
+    rows = buf.view(np.dtype((np.void, buf.shape[1]))).reshape(n)
+    _, rep, inv, counts = np.unique(
+        rows, return_index=True, return_inverse=True, return_counts=True
+    )
+    return PodClasses(
+        n_pods=n,
+        n_classes=int(rep.size),
+        class_of_pod=inv.astype(np.int32).reshape(n),
+        class_rep=rep.astype(np.int32).reshape(-1),
+        class_size=counts.astype(np.int32).reshape(-1),
+        signature_bytes=int(buf.shape[1]),
+    )
+
+
+def gather_class_pod_rows(tensors: Dict, class_rep: np.ndarray) -> Dict:
+    """The compressed tensor dict: per-pod arrays gathered at the class
+    representatives (pod axis N -> class axis C); policy tensors shared
+    by reference.  host_ip_match columns gather too — a host-evaluated
+    row's column is part of the class signature, so the representative's
+    value is the class value."""
+    t = dict(tensors)
+    for k in ("pod_ns_id", "pod_kv", "pod_key", "pod_ip", "pod_ip_valid"):
+        t[k] = np.ascontiguousarray(t[k][class_rep])
+    for direction in ("ingress", "egress"):
+        d = t[direction]
+        if "host_ip_match" in d:
+            d = dict(d)
+            d["host_ip_match"] = np.ascontiguousarray(
+                d["host_ip_match"][:, class_rep]
+            )
+            t[direction] = d
+    return t
+
+
+def _rows_as_bytes(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """[R, K] uint8 matrix whose row r concatenates the bytes of row r of
+    every input array (1-D or 2-D; bools and ints alike)."""
+    blocks = []
+    r = int(arrays[0].shape[0])
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        blocks.append(a.view(np.uint8).reshape(r, -1))
+    return np.concatenate(blocks, axis=1)
+
+
+def compress_rule_axes(d: Dict) -> Tuple[Dict, Dict[str, int]]:
+    """Tuple-space partition compression of one direction's rule axes.
+
+    Two exact reductions (verdicts depend on the target/peer axes only
+    through OR-reductions, so duplicates are redundant):
+
+      1. targets with identical (namespace, selector) merge into one row
+         — their tmatch rows are equal, and ORing their peer sets under
+         one row preserves any_allow > 0 and has_target exactly;
+      2. flat peer rules that are byte-identical across every matcher
+         array, their port-spec row, and their (merged) target collapse
+         to one row — the peer->target one-hot matmul only feeds a > 0
+         threshold, so multiplicity never matters.
+
+    Host-evaluated ip rows (host_ip_mask) never merge: their [N] match
+    columns live outside the row signature.  Returns the compressed
+    direction dict + stats, including `partitions`: the number of
+    distinct rule tuples ignoring the target — the tuple-space partition
+    count in the TSS sense."""
+    t_ns, t_sel = d["target_ns"], d["target_sel"]
+    t = int(t_ns.shape[0])
+    p = int(d["peer_target"].shape[0])
+    stats = {
+        "targets_before": t, "targets_after": t,
+        "peers_before": p, "peers_after": p, "partitions": 0,
+    }
+    if t == 0 or p == 0:
+        return d, stats
+    tkey = np.stack([t_ns, t_sel], axis=1)
+    uniq_t, t_inv = np.unique(tkey, axis=0, return_inverse=True)
+    t_inv = t_inv.astype(np.int32).reshape(-1)
+    pt = d["peer_target"]
+    new_pt = np.where(pt >= 0, t_inv[np.clip(pt, 0, t - 1)], np.int32(-1))
+
+    peer_arrays = [new_pt.reshape(-1, 1)]
+    for k in (
+        "peer_kind", "peer_ns_kind", "peer_ns_id", "peer_ns_sel",
+        "peer_pod_kind", "peer_pod_sel", "ip_base", "ip_mask", "ip_is_v4",
+        "ex_base", "ex_mask", "ex_valid",
+    ):
+        peer_arrays.append(d[k])
+    for k in sorted(d["port_spec"]):
+        peer_arrays.append(d["port_spec"][k])
+    # host rows: a unique per-row tag keeps them out of every merge group
+    host_tag = np.full((p,), -1, dtype=np.int32)
+    if "host_ip_mask" in d:
+        hr = np.flatnonzero(d["host_ip_mask"])
+        host_tag[hr] = np.arange(hr.size, dtype=np.int32)
+    peer_arrays.append(host_tag.reshape(-1, 1))
+    key_bytes = _rows_as_bytes(peer_arrays)
+    rows = np.ascontiguousarray(key_bytes).view(
+        np.dtype((np.void, key_bytes.shape[1]))
+    ).reshape(p)
+    _, keep = np.unique(rows, return_index=True)
+    keep = np.sort(keep)
+    # partition count: same signature with the target column blanked
+    part_bytes = key_bytes[:, 4:]
+    part_rows = np.ascontiguousarray(part_bytes).view(
+        np.dtype((np.void, part_bytes.shape[1]))
+    ).reshape(p)
+    stats["partitions"] = int(np.unique(part_rows).size)
+
+    nd = dict(d)
+    nd["target_ns"] = np.ascontiguousarray(uniq_t[:, 0].astype(np.int32))
+    nd["target_sel"] = np.ascontiguousarray(uniq_t[:, 1].astype(np.int32))
+    nd["peer_target"] = np.ascontiguousarray(new_pt[keep])
+    for k in (
+        "peer_kind", "peer_ns_kind", "peer_ns_id", "peer_ns_sel",
+        "peer_pod_kind", "peer_pod_sel", "ip_base", "ip_mask", "ip_is_v4",
+        "ex_base", "ex_mask", "ex_valid",
+    ):
+        nd[k] = np.ascontiguousarray(d[k][keep])
+    if "host_ip_mask" in d:
+        nd["host_ip_mask"] = np.ascontiguousarray(d["host_ip_mask"][keep])
+    if "host_ip_match" in d:
+        nd["host_ip_match"] = np.ascontiguousarray(d["host_ip_match"][keep])
+    nd["port_spec"] = {
+        k: np.ascontiguousarray(v[keep]) for k, v in d["port_spec"].items()
+    }
+    stats["targets_after"] = int(uniq_t.shape[0])
+    stats["peers_after"] = int(keep.size)
+    return nd, stats
+
+
 def encode_policy(
     policy: Policy,
     pods: Sequence[Tuple[str, str, Dict[str, str], str]],

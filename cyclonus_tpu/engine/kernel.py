@@ -319,6 +319,27 @@ def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
     }
 
 
+@contracts.args(class_of="(N,) int32")
+def gather_class_grids(
+    out: Dict[str, jnp.ndarray], class_of: jnp.ndarray
+) -> Dict[str, jnp.ndarray]:
+    """Broadcast class-grid verdicts back to the full pod x pod grid.
+
+    out: {ingress, egress, combined} [Q, C*, C*] bool over the (possibly
+    bucketing-padded) class axes; class_of: [N] int32 pod -> class map
+    (values < the real class count, so pad rows are never gathered).
+    Two chained int32 gathers per grid — cell (q, i, j) copies class
+    cell (q, class_of[i], class_of[j]), which is exact by the class
+    signature's completeness (encoding.compute_pod_classes).  Designed
+    to trace INSIDE the caller's jit so grid + gather stay one device
+    execution."""
+
+    def g(a: jnp.ndarray) -> jnp.ndarray:
+        return jnp.take(jnp.take(a, class_of, axis=1), class_of, axis=2)
+
+    return {k: g(v) for k, v in out.items()}
+
+
 @jax.jit
 def rule_firing_kernel(shared: Dict, enc: Dict) -> Dict[str, jnp.ndarray]:
     """Per-RULE firing-mask components for one direction — the batched

@@ -248,6 +248,32 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
     return ingress_rows, egress, combined
 
 
+def evaluate_class_grid_sharded(
+    tensors: Dict,
+    n_classes: int,
+    class_of: np.ndarray,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Mesh-sharded evaluation over the COMPRESSED class grid + the
+    int32 gather epilogue back to pod axes.
+
+    `tensors` carries class-representative rows on the pod axis
+    (encoding.gather_class_pod_rows); the shard_map program is exactly
+    evaluate_grid_sharded over that axis, and the broadcast back to the
+    full pod x pod grid is two chained jnp.take gathers per verdict
+    tensor — device-resident, lazy, identical in layout to the dense
+    path's outputs."""
+    ingress, egress, combined = evaluate_grid_sharded(
+        tensors, n_classes, mesh=mesh
+    )
+
+    def g(a):
+        # a: [C, C, Q] (either orientation) -> [N, N, Q]
+        return jnp.take(jnp.take(a, class_of, axis=0), class_of, axis=1)
+
+    return g(ingress), g(egress), g(combined)
+
+
 def evaluate_grid_sharded(
     tensors: Dict, n_pods: int, mesh: Optional[Mesh] = None
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

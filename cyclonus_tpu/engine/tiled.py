@@ -295,6 +295,209 @@ def evaluate_grid_counts(
     }
 
 
+# --- equivalence-class (compressed-grid) counts ---------------------------
+#
+# The compressed counts contract: with pods bucketed into C equivalence
+# classes (encoding.compute_pod_classes), every full-grid count is the
+# class-grid count weighted by class sizes:
+#
+#     count[q] = sum_{c1, c2} verdict[q, c1, c2] * size[c1] * size[c2]
+#
+# Exactness without float64 (disabled by default in JAX) is a two-stage
+# split: the DEVICE computes per-src-class weighted row sums
+# rs[c, q, k] = sum_dst verdict * w[dst] — every partial sum is an
+# integer <= N, exact in f32 while N < 2^24 (api gates the path on that
+# bound) — and the HOST finishes sum_c w[c] * rs[c] in int64, where the
+# ~1e12-scale products live.  The [Q, C, C] verdict grid never
+# materializes: the same _tile_verdicts_split body every dense tiled
+# path uses runs per class tile, with the count epilogue swapped for
+# the weighted row-sum einsum.
+
+
+def _class_tile_rowsums(
+    src: Dict, dst: Dict, w_dst: jnp.ndarray, start, block: int
+) -> jnp.ndarray:
+    """[block, Q, 3] f32 dst-weighted verdict row sums for src-view rows
+    [start, start+block): rs[b, q, k] = sum_dst grid_k * w_dst.  Pad
+    classes carry weight 0 on the dst side and are zeroed by the host
+    weighting on the src side, so no validity mask is needed."""
+    ingress_rows, egress, combined = _tile_verdicts_split(src, dst, start, block)
+
+    def rs(a: jnp.ndarray) -> jnp.ndarray:
+        # HIGHEST precision is load-bearing: TPU's default f32 matmul
+        # runs bf16 multiplies, which round class-size weights > 256
+        # (e.g. a 1955-pod class -> 1952) and would silently break the
+        # exact-integer contract class_counts_finish rounds on.  CPU
+        # (where the parity suites run) is exact either way — only the
+        # TPU mega shapes would see the corruption.
+        return jnp.einsum(
+            "bdq,d->bq",
+            a.astype(jnp.float32),
+            w_dst,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    return jnp.stack([rs(ingress_rows), rs(egress), rs(combined)], axis=-1)
+
+
+@partial(jax.jit, static_argnames=("block", "n_tiles"))
+def _class_rowsums_kernel(
+    tensors: Dict, w: jnp.ndarray, block: int, n_tiles: int
+) -> jnp.ndarray:
+    """[n_tiles * block, Q, 3] f32 weighted row sums over the class grid,
+    one device execution (fori_loop over class tiles)."""
+    pre = _precompute(tensors)
+    src, dst = _split_pre(pre)
+    q = tensors["q_port"].shape[0]
+
+    def body(i, out):
+        rs = _class_tile_rowsums(src, dst, w, i * block, block)
+        return jax.lax.dynamic_update_slice(out, rs, (i * block, 0, 0))
+
+    out = jnp.zeros((n_tiles * block, q, 3), dtype=jnp.float32)
+    return jax.lax.fori_loop(0, n_tiles, body, out)
+
+
+def class_rowsums_plan(
+    tensors: Dict, n_classes: int, class_size: np.ndarray, block: int = 1024
+):
+    """(w, block, n_tiles) for the class row-sum kernel over `tensors`
+    whose pod axis is the (bucketing-padded) class axis.  Bucketed axes
+    (api._bucket_pods) are powers of two or multiples of 1024, so
+    min(block, 1024, cb) always divides cb; the fallback to the whole
+    axis covers hand-built tensor dicts only."""
+    cb = int(tensors["pod_ns_id"].shape[0])
+    block = max(1, min(block, 1024, cb))
+    if cb % block:
+        block = cb
+    w = np.zeros((cb,), dtype=np.float32)
+    w[:n_classes] = np.asarray(class_size, dtype=np.float32)
+    return w, block, cb // block
+
+
+def class_counts_finish(
+    rowsums: np.ndarray,
+    class_size: np.ndarray,
+    n_classes: int,
+    q: int,
+    n_pods: int,
+) -> Dict[str, int]:
+    """Exact int64 host finish of the device row sums: the src-side
+    class weighting.  Row-sum entries are integers <= N held exactly in
+    f32 (N < 2^24 gated by the caller); the products reach ~N^2 and live
+    in int64 only."""
+    rs = np.rint(np.asarray(rowsums)[:n_classes]).astype(np.int64)  # [C, Q, 3]
+    w = np.asarray(class_size, dtype=np.int64)
+    totals = (w[:, None, None] * rs).sum(axis=(0, 1))  # [3]
+    return {
+        "ingress": int(totals[0]),
+        "egress": int(totals[1]),
+        "combined": int(totals[2]),
+        "cells": q * n_pods * n_pods,
+    }
+
+
+def evaluate_grid_counts_classes(
+    tensors: Dict,
+    n_classes: int,
+    class_size: np.ndarray,
+    n_pods: int,
+    block: int = 1024,
+) -> Tuple[Dict[str, int], float]:
+    """Allow counts over the FULL N x N x Q grid, evaluated on the
+    compressed C x C class grid and weighted back exactly.  Returns
+    (counts, gather_s) where gather_s is the broadcast-back epilogue
+    (the host weighting) — the cheap gather the compression trades the
+    dense grid for."""
+    import time as _time
+
+    q = int(tensors["q_port"].shape[0])
+    w, block, n_tiles = class_rowsums_plan(tensors, n_classes, class_size, block)
+    with ti.eval_flight(
+        "counts.classes", n_pods, q, classes=n_classes, block=block
+    ) as fl:
+        with phase("engine.dispatch"):
+            out = _class_rowsums_kernel(tensors, w, block, n_tiles)
+        # the readback is the execution barrier (dispatch is async)
+        with phase("engine.execute"):
+            rs = np.asarray(out)
+        t0 = _time.perf_counter()
+        counts = class_counts_finish(rs, class_size, n_classes, q, n_pods)
+        gather_s = _time.perf_counter() - t0
+        fl.set(cells=counts["cells"])
+    return counts, gather_s
+
+
+def evaluate_grid_counts_classes_sharded(
+    tensors: Dict,
+    n_classes: int,
+    class_size: np.ndarray,
+    n_pods: int,
+    block: int = 1024,
+    mesh=None,
+) -> Tuple[Dict[str, int], float]:
+    """Mesh-parallel compressed counts: the CLASS axis (already tiny
+    next to the pod axis) splits over the mesh, each device computes the
+    weighted row sums for its class shard against the replicated dst
+    view, and one all-gather hands the [C, Q, 3] row sums to the same
+    exact host finish as the single-device path."""
+    import time as _time
+
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import mesh_device_context, shard_map_no_check
+
+    mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
+        tensors, n_classes, block, mesh
+    )
+    shard = n_padded // n_dev
+    tiles_per_shard = shard // block
+    w = np.zeros((n_padded,), dtype=np.float32)
+    w[:n_classes] = np.asarray(class_size, dtype=np.float32)
+    t = dict(tensors)
+    t["class_w"] = w
+
+    def per_device(td):
+        w_all = td["class_w"]
+        pre = _precompute({k: v for k, v in td.items() if k != "class_w"})
+        src, dst = _split_pre(pre)
+        dev = jax.lax.axis_index("x")
+        row0 = dev * shard
+
+        def body(i, out):
+            rs = _class_tile_rowsums(src, dst, w_all, row0 + i * block, block)
+            return jax.lax.dynamic_update_slice(out, rs, (i * block, 0, 0))
+
+        out = jax.lax.fori_loop(
+            0,
+            tiles_per_shard,
+            body,
+            jnp.zeros((shard, q, 3), dtype=jnp.float32),
+        )
+        return jax.lax.all_gather(out, "x", axis=0, tiled=True)
+
+    in_specs = jax.tree_util.tree_map(lambda _: P(), t)
+    fn = jax.jit(
+        shard_map_no_check(
+            per_device, mesh=mesh, in_specs=(in_specs,), out_specs=P()
+        )
+    )
+    with ti.eval_flight(
+        "counts.classes.sharded",
+        n_pods,
+        q,
+        classes=n_classes,
+        devices=int(n_dev),
+    ) as fl:
+        with mesh_device_context(mesh):
+            rs = np.asarray(fn(t))
+        t0 = _time.perf_counter()
+        counts = class_counts_finish(rs, class_size, n_classes, q, n_pods)
+        gather_s = _time.perf_counter() - t0
+        fl.set(cells=counts["cells"])
+    return counts, gather_s
+
+
 @partial(jax.jit, static_argnames=("block",))
 def _block_kernel(pre: Dict, start: jnp.ndarray, block: int):
     return _tile_verdicts(pre, start, block)

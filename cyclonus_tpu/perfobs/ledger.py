@@ -156,6 +156,9 @@ def _bench_run_from_parsed(
     cold = detail.get("cold_start") or detail.get("retries") or {}
     if isinstance(cold, dict):
         run.retries = dict(cold)
+    cc = detail.get("class_compression")
+    if isinstance(cc, dict) and isinstance(cc.get("ratio"), (int, float)):
+        run.class_compression_ratio = float(cc["ratio"])
     mesh = detail.get("mesh_scaling") or {}
     rows = [
         r

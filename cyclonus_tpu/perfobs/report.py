@@ -45,6 +45,12 @@ PERF_CELLS_PER_SEC_PER_CHIP = REGISTRY.gauge(
     "CPU-mesh rates, which are shape evidence, not speedup).",
     labelnames=("run", "virtual"),
 )
+PERF_CLASS_RATIO = REGISTRY.gauge(
+    "cyclonus_tpu_perf_class_compression_ratio",
+    "Ledger: equivalence-class compression ratio (pods/classes) of runs "
+    "that recorded one.",
+    labelnames=("run",),
+)
 PERF_RUNS = REGISTRY.gauge(
     "cyclonus_tpu_perf_runs",
     "Ledger: ingested runs by failure class.",
@@ -71,6 +77,10 @@ def publish(ledger: Ledger, result: Optional[GateResult] = None) -> None:
             PERF_WARMUP_SECONDS.set(run.warmup_s, run=run.run_id)
         for phase, seconds in run.phases.items():
             PERF_PHASE_SECONDS.set(seconds, run=run.run_id, phase=phase)
+        if run.class_compression_ratio is not None:
+            PERF_CLASS_RATIO.set(
+                run.class_compression_ratio, run=run.run_id
+            )
         if run.failure_class == "ok":
             best = max(best, run.cells_per_sec)
     for run in ledger.runs:
@@ -104,6 +114,11 @@ def trend(ledger: Ledger, result: Optional[GateResult] = None) -> Dict[str, Any]
             {"run": r.run_id, "cells_per_sec": r.cells_per_sec}
             for r in ok_runs
         ],
+        "class_compression": [
+            {"run": r.run_id, "ratio": r.class_compression_ratio}
+            for r in ledger.bench_runs()
+            if r.class_compression_ratio is not None
+        ],
     }
     if result is not None:
         doc["gate"] = result.to_dict()
@@ -125,14 +140,19 @@ def render_markdown(
     lines = [
         "# Perf observatory",
         "",
-        "| run | kind | class | cells/s | warmup_s | per-chip | note |",
-        "|---|---|---|---|---|---|---|",
+        "| run | kind | class | cells/s | warmup_s | per-chip | cls-ratio | note |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in ledger.runs:
         per_chip = (
             f"{_human_rate(r.cells_per_sec_per_chip)}"
             + (" (virtual)" if r.virtual_mesh else "")
             if r.cells_per_sec_per_chip is not None
+            else "-"
+        )
+        ratio = (
+            f"{r.class_compression_ratio:g}x"
+            if r.class_compression_ratio is not None
             else "-"
         )
         note = ""
@@ -142,7 +162,7 @@ def render_markdown(
             f"| {r.run_id} | {r.kind} | {r.failure_class} "
             f"| {_human_rate(r.cells_per_sec) if r.cells_per_sec else '-'} "
             f"| {r.warmup_s if r.warmup_s is not None else '-'} "
-            f"| {per_chip} | {note} |"
+            f"| {per_chip} | {ratio} | {note} |"
         )
     by_class = ledger.counts_by_class()
     infra = sum(by_class[c] for c in INFRA_CLASSES)

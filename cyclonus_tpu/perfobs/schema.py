@@ -72,6 +72,11 @@ class PerfRun:
     telemetry_counters: Dict[str, float] = field(default_factory=dict)
     # cold-start forensics: backend-init attempts, backoff, outcome
     retries: Dict[str, Any] = field(default_factory=dict)
+    # detail.class_compression.ratio — pods/classes of the headline
+    # engine's equivalence-class grid compression (None: not recorded
+    # or compression inactive).  The sentinel WARNS (never fails) when
+    # it degrades >2x vs the baseline best on the same workload.
+    class_compression_ratio: Optional[float] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -94,6 +99,7 @@ class PerfRun:
             "warmup_phases": dict(self.warmup_phases),
             "telemetry_counters": dict(self.telemetry_counters),
             "retries": dict(self.retries),
+            "class_compression_ratio": self.class_compression_ratio,
             "error": self.error,
             "metric": self.metric,
         }

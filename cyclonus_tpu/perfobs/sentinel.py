@@ -237,6 +237,30 @@ def gate(
             )
         )
 
+    # --- class compression ratio: WARN, never fail ----------------------
+    # the ratio is workload-shaped (a cluster with genuinely more label
+    # diversity legitimately compresses less), so a degradation is a
+    # note for a human, not a regression — the cells/s gate above
+    # already covers any real perf impact of a lost compression
+    ratios = [
+        r.class_compression_ratio
+        for r in baselines
+        if isinstance(r.class_compression_ratio, (int, float))
+    ]
+    if ratios and isinstance(
+        candidate.class_compression_ratio, (int, float)
+    ):
+        best_ratio = max(ratios)
+        if candidate.class_compression_ratio < best_ratio / 2.0:
+            notes.append(
+                "WARNING: class_compression_ratio degraded >2x vs "
+                f"baseline: candidate "
+                f"{candidate.class_compression_ratio:g} vs best "
+                f"{best_ratio:g} — reported only (warn, not fail); "
+                "check the encoding/class signature before the next "
+                "large-cluster run"
+            )
+
     # --- per-phase bounds: every phase both sides know ------------------
     for phase, cand_s in sorted(candidate.phases.items()):
         if phase in _DEDICATED_PHASES:

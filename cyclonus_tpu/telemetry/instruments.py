@@ -79,6 +79,41 @@ PRE_CACHE_BUDGET_BYTES = REGISTRY.gauge(
     "Precompute pin ceiling (engine/api.py _PRE_CACHE_MAX_BYTES).",
 )
 
+# --- equivalence-class grid compression ----------------------------------
+
+CLASS_PODS = REGISTRY.gauge(
+    "cyclonus_tpu_class_pods",
+    "Grid compression: real pod count of the engine whose classes were "
+    "last computed.",
+)
+CLASS_COUNT = REGISTRY.gauge(
+    "cyclonus_tpu_class_count",
+    "Grid compression: label-equivalence class count (the compressed "
+    "pod-axis length).",
+)
+CLASS_RATIO = REGISTRY.gauge(
+    "cyclonus_tpu_class_compression_ratio",
+    "Grid compression: pods / classes (1.0 = no reduction; the grid "
+    "work shrinks by ratio^2).",
+)
+CLASS_GATHER_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_class_gather_seconds",
+    "Grid compression: last broadcast-back epilogue (gather / class-"
+    "size weighting) wall-clock.",
+)
+CLASS_AUX_BYTES = REGISTRY.gauge(
+    "cyclonus_tpu_class_aux_bytes",
+    "Grid compression: device bytes of the gather/index tensors (class "
+    "map, weights, compressed tensor buffer) counted against the "
+    "CYCLONUS_SLAB_MAX_BYTES budget.",
+)
+CLASS_EVALS = REGISTRY.counter(
+    "cyclonus_tpu_class_evals_total",
+    "Evaluations served by the compressed class path, by path "
+    "(grid/counts/sharded).",
+    labelnames=("path",),
+)
+
 # --- cache hit/miss counters --------------------------------------------
 
 PRE_CACHE_HITS = REGISTRY.counter(

@@ -219,6 +219,14 @@ class TestBenchGuards:
         assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
         # roofline only reports for the pallas backend
         assert detail["roofline"] is None
+        # class compression rides EVERY line (perfobs reads its ratio);
+        # at 256 pods the auto mode stays on the legacy paths
+        cc = detail["class_compression"]
+        assert cc["active"] is False and cc["pods"] == 256
+        assert cc["ratio"] is None
+        # BENCH_MEGA defaults to auto = TPU-only; on this CPU run the
+        # block records as absent-by-default
+        assert detail["mega_class"] is None
         # the telemetry block rides every BENCH line (and thus every
         # tunnel_wait round file): metrics incl. cache hit/miss counters
         # + HBM watermarks, span aggregates, and the flight window
@@ -248,3 +256,40 @@ class TestBenchGuards:
         # whether a --trace-dir/BENCH_TRACE_DIR jax-profiler artifact
         # was written this run (here: no capture requested)
         assert detail["trace"] == {"dir": None, "written": False}
+
+    def test_mega_class_case_records_compression(self):
+        """BENCH_MEGA=1 (shrunk for CI) runs the synthetic-cluster
+        compression case: detail.mega_class.class_compression carries
+        pods/classes/ratio/gather_s, the HBM-budget check, the oracle
+        spot parity, and the class-reduction audit — the same block the
+        1M-pod TPU run records."""
+        proc = run_bench(
+            {
+                "BENCH_PODS": "128",
+                "BENCH_POLICIES": "12",
+                "BENCH_SAMPLE": "3",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+                "BENCH_COUNTS_BACKEND": "xla",
+                "BENCH_MEGA": "1",
+                "BENCH_MEGA_PODS": "4096",
+                "BENCH_MEGA_POLICIES": "32",
+                "BENCH_MEGA_NS": "8",
+                "BENCH_MEGA_SAMPLE": "4",
+            },
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-500:]
+        out = last_json_line(proc.stdout)
+        mega = out["detail"]["mega_class"]
+        assert mega is not None and "status" not in mega, mega
+        cc = mega["class_compression"]
+        assert cc["active"] is True
+        assert cc["pods"] == 4096
+        assert 0 < cc["classes"] < 4096
+        assert cc["ratio"] > 1.0
+        assert cc["gather_s"] is not None
+        assert mega["hbm_budget_ok"] is True
+        assert mega["audit"]["ok"] is True
+        assert mega["parity_spot_checks"] == 4
+        assert mega["cells"] == 2 * 4096 * 4096

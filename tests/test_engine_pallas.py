@@ -874,6 +874,11 @@ class TestSlabLayout:
                    ingress=[NetworkPolicyIngressRule()])],
         )
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
+        # this test pins the slab BYTE accounting with an exact budget;
+        # class compression would add its aux/index bytes to the same
+        # budget (its own test: test_engine_classes.py) and skew the
+        # equality below
+        monkeypatch.setenv("CYCLONUS_CLASS_COMPRESS", "0")
 
         monkeypatch.setenv("CYCLONUS_PALLAS_DTYPE", "int8")
         engine = TpuPolicyEngine(policy, pods, namespaces)

@@ -774,6 +774,83 @@ class TestUnparseableIPs:
         assert counts["cells"] == len(pods) ** 2
 
 
+def _truth_tables(engine, cases):
+    import numpy as np
+
+    g = engine.evaluate_grid(cases)
+    return tuple(
+        np.asarray(x).copy() for x in (g.ingress, g.egress, g.combined)
+    )
+
+
+class TestCompressedParity:
+    """Equivalence-class grid compression (docs/DESIGN.md "Grid
+    compression"): the compressed path vs the dense path vs the scalar
+    oracle on the example fixtures — BIT-IDENTICAL truth tables, and
+    counts engines matching the oracle-checked grid sums.  `make check`
+    re-runs this file with CYCLONUS_SHAPE_CHECK=1 and compression
+    forced, so the class tensors' contracts validate live."""
+
+    def _replica_cluster(self):
+        """default_cluster plus label-identical replicas: real class
+        merging (replicas share a signature by construction)."""
+        pods, namespaces = default_cluster()
+        extra = []
+        for ns, name, labels, _ in list(pods):
+            for r in range(2):
+                extra.append(
+                    (ns, f"{name}-r{r}", dict(labels), f"192.168.9.{len(extra) + 1}")
+                )
+        return pods + extra, namespaces
+
+    def test_bundled_fixture_compressed_vs_dense_vs_oracle(self, monkeypatch):
+        import numpy as np
+
+        pols = load_policies_from_path(BUNDLED)
+        policy = build_network_policies(True, pols)
+        pods, namespaces = self._replica_cluster()
+        monkeypatch.setenv("CYCLONUS_CLASS_COMPRESS", "1")
+        # oracle parity of the COMPRESSED engine, incl. the xla/pallas
+        # counts engines vs the oracle-checked grid sums
+        assert_parity(policy, pods, namespaces, CASES_MULTI, counts=True)
+        eng_c = TpuPolicyEngine(policy, pods, namespaces)
+        pc = eng_c.pod_classes()
+        assert pc is not None and pc.n_classes < len(pods)
+        tt_c = _truth_tables(eng_c, CASES_MULTI)
+        cnt_c = eng_c.evaluate_grid_counts(CASES_MULTI)
+        sh_c = np.asarray(eng_c.evaluate_grid_sharded(CASES_MULTI).combined)
+        monkeypatch.setenv("CYCLONUS_CLASS_COMPRESS", "0")
+        eng_d = TpuPolicyEngine(policy, pods, namespaces)
+        tt_d = _truth_tables(eng_d, CASES_MULTI)
+        for a, b in zip(tt_c, tt_d):
+            assert np.array_equal(a, b)
+        cnt_d = eng_d.evaluate_grid_counts(CASES_MULTI, block=16, backend="xla")
+        assert cnt_c == cnt_d
+        assert np.array_equal(sh_c, tt_d[2])
+
+    def test_feature_fixtures_compressed(self, monkeypatch):
+        """Port ranges + the other bundled feature files through the
+        compressed engine vs the oracle."""
+        monkeypatch.setenv("CYCLONUS_CLASS_COMPRESS", "1")
+        pols = load_policies_from_path(str(FIXTURES / "features"))
+        policy = build_network_policies(True, pols)
+        pods, namespaces = self._replica_cluster()
+        cases = [
+            PortCase(79, "", "TCP"),
+            PortCase(80, "", "TCP"),
+            PortCase(104, "", "TCP"),
+            PortCase(53, "", "UDP"),
+        ]
+        assert_parity(policy, pods, namespaces, cases, counts=True)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_compressed(self, seed, monkeypatch):
+        """Randomized clusters through the forced-compression engine:
+        oracle vs grid kernel plus both counts engines."""
+        monkeypatch.setenv("CYCLONUS_CLASS_COMPRESS", "1")
+        run_fuzz_seed(seed, counts=True)
+
+
 class TestFuzzParity:
     @pytest.mark.parametrize("seed", range(12))
     def test_fuzz(self, seed):
