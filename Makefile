@@ -23,12 +23,12 @@ lint: shapelint
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
-	  cyclonus_tpu/perfobs
+	  cyclonus_tpu/perfobs cyclonus_tpu/serve
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
-	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs
+	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -50,10 +50,17 @@ parity-compressed:
 	  python -m pytest tests/test_engine_parity.py \
 	  tests/test_engine_classes.py -q
 
+# verdict-service smoke (docs/DESIGN.md "Verdict service"): start a real
+# `cyclonus-tpu serve` subprocess, apply a delta batch over the wire
+# (asserting the single-pod delta takes the INCREMENTAL path), query,
+# assert every verdict against the scalar oracle, clean shutdown
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
-# then run the suite on a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed
+# smoke the verdict service, then run the suite on a CPU 8-device mesh
+check: vet lint perf-gate parity-compressed serve-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -89,4 +96,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz race bench fmt vet lint shapelint perf-gate parity-compressed cyclonus docker
+.PHONY: test check conformance fuzz race bench fmt vet lint shapelint perf-gate parity-compressed serve-smoke cyclonus docker

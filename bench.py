@@ -657,6 +657,177 @@ def mesh_scaling(pods, namespaces, policies, cases) -> dict:
     }
 
 
+def serve_churn_case(cases, headline_pods: int, headline_policies: int) -> dict:
+    """BENCH serve leg (detail.serve): a VerdictService on a
+    BENCH_SERVE_PODS-pod synthetic cluster, a seeded stream of
+    BENCH_SERVE_DELTAS single-pod deltas applied one at a time with
+    BENCH_SERVE_QUERIES flow queries interleaved — incremental_apply_s
+    vs full_rebuild_s, queries/s under churn, and the differential
+    parity gate.
+
+    The acceptance assertions are hard failures: every delta must take
+    the INCREMENTAL path (no full re-encode, no re-device_put of
+    untouched slabs — pinned via the engine.encode / engine.device_put
+    span counters), and the patched engine must stay bit-identical to a
+    fresh rebuild with oracle spot checks (VerdictService.verify_parity)."""
+    import random as _random
+
+    from cyclonus_tpu import telemetry
+    from cyclonus_tpu.serve import VerdictService
+    from cyclonus_tpu.serve.service import histogram_quantile
+    from cyclonus_tpu.telemetry import instruments as ti
+    from cyclonus_tpu.worker.model import Delta, FlowQuery
+
+    n_pods = int(
+        os.environ.get("BENCH_SERVE_PODS", "0")
+    ) or min(1024, headline_pods)
+    n_policies = int(
+        os.environ.get("BENCH_SERVE_POLICIES", "0")
+    ) or min(128, max(headline_policies, 8))
+    k_deltas = int(os.environ.get("BENCH_SERVE_DELTAS", "32"))
+    q_per_step = int(os.environ.get("BENCH_SERVE_QUERIES", "8"))
+    rng = _random.Random(123)
+    pods, namespaces, pol_objs = build_synthetic(n_pods, n_policies, rng)
+    t0 = time.perf_counter()
+    svc = VerdictService(pods, namespaces, pol_objs)
+    build_s = time.perf_counter() - t0
+    full_rebuild_s = svc.state()["last_full_rebuild_s"]
+    # warm the device state + the query program before timing churn
+    keys = list(svc.pods)
+    warm_q = FlowQuery(
+        src=keys[0], dst=keys[1], port=80, protocol="TCP",
+        port_name="serve-80-tcp",
+    )
+    svc.query([warm_q])
+    svc.apply([Delta(
+        kind="pod_labels", namespace=pods[0][0], name=pods[0][1],
+        labels={**pods[0][2], "tier": "tier1"},
+    )])  # warm the scatter program too
+    spans = telemetry.SPANS.stats()
+    encodes0 = spans.get("engine.encode", {}).get("count", 0)
+    device_puts0 = spans.get("engine.device_put", {}).get("count", 0)
+    patch_bytes0 = ti.SERVE_PATCH_BYTES.value()
+    apply_times, query_times, n_queries = [], [], 0
+    for step in range(k_deltas):
+        key = keys[rng.randrange(len(keys))]
+        ns, name = key.split("/", 1)
+        if step % 5 == 4:
+            # delete-then-recreate: the remove frees the row the add
+            # re-claims, so the pair stays within the bucketed capacity
+            pod = svc.pods[key]
+            batch = [
+                Delta(kind="pod_remove", namespace=ns, name=name),
+                Delta(kind="pod_add", namespace=ns, name=name,
+                      labels=dict(pod[2]), ip=pod[3]),
+            ]
+        else:
+            batch = [Delta(
+                kind="pod_labels", namespace=ns, name=name,
+                labels={
+                    "pod": f"p{rng.randrange(100)}",
+                    "app": f"app{rng.randrange(20)}",
+                    "tier": f"tier{rng.randrange(5)}",
+                },
+            )]
+        report = svc.apply(batch)
+        # class_rebuild is still a patch path (only the class buffer
+        # re-uploads; the main buffer and compiled programs survive) —
+        # it appears under CYCLONUS_CLASS_COMPRESS=1 only: serve engines
+        # build compact=False, which skips the selector pass auto mode
+        # reuses, so auto compression never activates here regardless of
+        # BENCH_SERVE_PODS.  Only "full" (re-encode + re-device_put)
+        # fails.
+        if report["mode"] not in ("incremental", "class_rebuild"):
+            raise AssertionError(
+                f"SERVE CHURN: delta step {step} took mode "
+                f"{report['mode']!r}, expected an incremental patch "
+                f"({batch})"
+            )
+        apply_times.append(report["seconds"])
+        queries = []
+        for _ in range(q_per_step):
+            a, b = rng.choice(keys), rng.choice(keys)
+            if rng.random() < 0.5:
+                queries.append(FlowQuery(
+                    src=a, dst=b, port=80, protocol="TCP",
+                    port_name="serve-80-tcp",
+                ))
+            else:
+                queries.append(FlowQuery(
+                    src=a, dst=b, port=81, protocol="UDP",
+                    port_name="serve-81-udp",
+                ))
+        tq = time.perf_counter()
+        svc.query(queries)
+        query_times.append(time.perf_counter() - tq)
+        n_queries += len(queries)
+    spans = telemetry.SPANS.stats()
+    encodes = spans.get("engine.encode", {}).get("count", 0)
+    device_puts = spans.get("engine.device_put", {}).get("count", 0)
+    if encodes != encodes0 or device_puts != device_puts0:
+        raise AssertionError(
+            "SERVE CHURN: incremental applies re-encoded or re-"
+            f"device_put ({encodes - encodes0} encodes, "
+            f"{device_puts - device_puts0} device_puts)"
+        )
+    patch_bytes = ti.SERVE_PATCH_BYTES.value() - patch_bytes0
+    parity = svc.verify_parity(oracle_samples=32)
+    incr_mean = sum(apply_times) / max(len(apply_times), 1)
+    qps = n_queries / max(sum(query_times), 1e-9)
+    hist = ti.SERVE_QUERY_LATENCY.snapshot()
+    st = svc.state()
+    return {
+        "pods": n_pods,
+        "policies": n_policies,
+        "deltas": k_deltas,
+        "build_s": round(build_s, 3),
+        "full_rebuild_s": round(full_rebuild_s, 4),
+        "incremental_apply_s": round(incr_mean, 5),
+        "incremental_apply_max_s": round(max(apply_times), 5),
+        "speedup_vs_rebuild": round(full_rebuild_s / max(incr_mean, 1e-9), 1),
+        "queries": n_queries,
+        "queries_per_sec": round(qps, 1),
+        "query_p50_ms": (
+            round(histogram_quantile(hist, 0.50) * 1e3, 3)
+            if histogram_quantile(hist, 0.50) is not None
+            else None
+        ),
+        "query_p99_ms": (
+            round(histogram_quantile(hist, 0.99) * 1e3, 3)
+            if histogram_quantile(hist, 0.99) is not None
+            else None
+        ),
+        "patch_bytes": int(patch_bytes),
+        "no_reencode": True,
+        "applies": st["applies"],
+        "parity": parity,
+    }
+
+
+def _serve_churn_leg(cases, n_pods: int, n_policies: int):
+    """Bounded wrapper for the serve leg (BENCH_SERVE=0 skips): like the
+    mega/sharded legs, a wedged compile must cost only this detail
+    block, but correctness failures (the incremental-path assertion or
+    the differential gate) re-raise loudly."""
+    if os.environ.get("BENCH_SERVE", "1") != "1":
+        return None
+    from cyclonus_tpu.utils.bounded import run_bounded
+
+    _stall_env = float(os.environ.get("BENCH_STALL_S", "300"))
+    _bound = min(240.0, _stall_env * 0.8) if _stall_env > 0 else 600.0
+    status, value = run_bounded(
+        lambda: serve_churn_case(cases, n_pods, n_policies), _bound
+    )
+    if status == "ok":
+        return value
+    if status == "error" and isinstance(value, AssertionError):
+        raise value
+    return {
+        "status": status,
+        "error": None if status == "timeout" else repr(value),
+    }
+
+
 def mega_class_case(cases) -> dict:
     """The 1M-pod synthetic-cluster case (ROADMAP item 2): a cluster an
     order of magnitude past the headline shape, evaluable on one chip
@@ -1178,6 +1349,14 @@ def _bench(done):
                 random.Random(77),
             )
             mesh_detail = mesh_scaling(m_pods, m_ns, m_pols, cases)
+        # snapshot the telemetry block BEFORE the serve leg: its delta/
+        # query churn floods the 64-entry flight-recorder ring with
+        # pairs evaluations, and the BENCH telemetry block must keep
+        # recording the HEADLINE engine's state (detail.serve carries
+        # the serve leg's own numbers)
+        tel_snapshot = telemetry.snapshot()
+        _enter_phase("serve_churn")
+        serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
         done.set()
         print(
             json.dumps(
@@ -1258,6 +1437,13 @@ def _bench(done):
                         # broadcast-back epilogue seconds (perfobs reads
                         # detail.class_compression.ratio on every line)
                         "class_compression": engine.class_compression_stats(),
+                        # the verdict-service churn leg (BENCH_SERVE=0
+                        # to skip): incremental_apply_s vs
+                        # full_rebuild_s and queries/s under a seeded
+                        # delta stream, with the incremental-path and
+                        # differential-parity assertions enforced
+                        # (perfobs reads detail.serve on every line)
+                        "serve": serve_detail,
                         # the 1M-pod synthetic case (BENCH_MEGA): the
                         # compression-only shape, with its own
                         # class_compression block, HBM-budget check,
@@ -1271,7 +1457,8 @@ def _bench(done):
                         # hit/miss + HBM watermarks, span aggregates,
                         # flight-recorder window) so tunnel_wait round
                         # files carry the engine's internal state
-                        "telemetry": telemetry.snapshot(),
+                        # (captured before the serve leg — see above)
+                        "telemetry": tel_snapshot,
                         # device-profile provenance: the --trace-dir /
                         # BENCH_TRACE_DIR capture, and whether the
                         # profiler actually wrote an artifact
@@ -1314,6 +1501,11 @@ def _bench(done):
     spot_check(policy, pods, namespaces, cases, grid, n_samples, rng)
 
     allow_rate = grid.allow_stats()["combined"]
+    # snapshot before the serve leg floods the flight-recorder ring
+    # (same rationale as the tiled branch)
+    tel_snapshot = telemetry.snapshot()
+    _enter_phase("serve_churn")
+    serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
     done.set()
     print(
         json.dumps(
@@ -1338,7 +1530,8 @@ def _bench(done):
                     "allow_rate": round(allow_rate, 4),
                     "parity_spot_checks": n_samples,
                     "class_compression": engine.class_compression_stats(),
-                    "telemetry": telemetry.snapshot(),
+                    "serve": serve_detail,
+                    "telemetry": tel_snapshot,
                     "trace": _trace_detail(trace_dir),
                 },
             }

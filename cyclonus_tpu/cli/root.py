@@ -30,12 +30,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .perf_cmd import setup_perf
     from .probe_cmd import setup_probe
     from .recipes_cmd import setup_recipes
+    from .serve_cmd import setup_serve
 
     setup_analyze(sub)
     setup_generate(sub)
     setup_perf(sub)
     setup_probe(sub)
     setup_recipes(sub)
+    setup_serve(sub)
 
     telemetry_cmd = sub.add_parser(
         "telemetry",

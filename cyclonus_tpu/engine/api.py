@@ -589,10 +589,21 @@ def _pack_tensors(tree):
     to be traced INSIDE a consumer jit (so the unpack adds no extra
     dispatch or executable of its own).
 
-    Returns (packed_int32_np, unpack) where unpack(buf_jnp) -> pytree."""
+    Returns (packed_int32_np, unpack) where unpack(buf_jnp) -> pytree.
+    The per-leaf layout rides along as `unpack.metas_by_path`
+    ({("ingress", "ip_base"): (dtype, shape, word_offset, n_words), ...})
+    — the delta path (cyclonus_tpu/serve) uses it to scatter-patch
+    touched rows of the device buffer without re-transferring anything
+    else.  Every leaf starts on a fresh int32 word (tail bytes are
+    zero-padded), so row patches never cross leaf boundaries."""
     from jax import tree_util as jtu
 
-    leaves, treedef = jtu.tree_flatten(tree)
+    path_leaves, treedef = jtu.tree_flatten_with_path(tree)
+    leaves = [leaf for _path, leaf in path_leaves]
+    paths = [
+        tuple(getattr(k, "key", str(k)) for k in path)
+        for path, _leaf in path_leaves
+    ]
     metas = []  # (dtype, shape, word_offset, n_words)
     chunks = []
     off = 0
@@ -634,6 +645,7 @@ def _pack_tensors(tree):
             outs.append(arr.reshape(shape))
         return jtu2.tree_unflatten(treedef, outs)
 
+    unpack.metas_by_path = dict(zip(paths, metas))
     return packed, unpack
 
 
@@ -666,12 +678,23 @@ class TpuPolicyEngine:
         policy: Policy,
         pods: Sequence[Tuple[str, str, Dict[str, str], str]],
         namespaces: Dict[str, Dict[str, str]],
+        *,
+        compact: Optional[bool] = None,
+        class_compress: Optional[str] = None,
     ):
+        # compact/class_compress override the CYCLONUS_COMPACT /
+        # CYCLONUS_CLASS_COMPRESS env defaults per engine (None = env).
+        # The serve layer builds its engines with compact=False — dead-
+        # target compaction bakes "no pod matches this target" into the
+        # tensors, and a pod delta can make a dead target live, so a
+        # delta-oriented engine must keep every target resident.
         # every evaluation path below is jax-backed: first-touch setup of
         # the persistent compile cache happens here, not at import time
         from . import ensure_persistent_compile_cache
 
         ensure_persistent_compile_cache()
+        self._opt_compact = compact
+        self._opt_class_compress = class_compress
         with phase("engine.encode"):
             self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
             self._tensors = self._build_tensors()
@@ -680,7 +703,12 @@ class TpuPolicyEngine:
             # (selector and pod axes are unchanged by compaction, only
             # padded by bucketing)
             self._selpod_prebucket = None
-            if _compaction_enabled(self._tensors):
+            compact_on = (
+                _compaction_enabled(self._tensors)
+                if compact is None
+                else bool(compact)
+            )
+            if compact_on:
                 with phase("engine.compact"):
                     self._selpod_prebucket = _selector_pod_matches_host(
                         self._tensors
@@ -696,7 +724,11 @@ class TpuPolicyEngine:
             # reduction (auto mode) before paying for a second tensor set
             self._partition_stats = None
             self._class_state = None
-            mode = _class_compress_mode()
+            mode = (
+                _class_compress_mode()
+                if class_compress is None
+                else str(class_compress).lower()
+            )
             if mode != "0":
                 with phase("engine.partition"):
                     pstats = {}
@@ -727,6 +759,11 @@ class TpuPolicyEngine:
         self._device_tensors = None  # lazily device_put once
         self._packed_buf = None  # single-buffer device copy (all paths)
         self._unpack = None
+        # jit wrappers over the unpack closures, cached so the serve
+        # layer's patch/invalidate cycle re-unpacks through the SAME
+        # compiled program instead of retracing per patch
+        self._unpack_jit = None
+        self._class_unpack_jit = None
         # compressed-path device state (all lazy; None when no class
         # state): packed class-representative buffer + unpacked pytree,
         # the pod->class gather map, and the fused grid+gather program
@@ -792,6 +829,34 @@ class TpuPolicyEngine:
 
     def pod_index(self) -> Dict[str, int]:
         return {k: i for i, k in enumerate(self.pod_keys)}
+
+    def invalidate_after_patch(self) -> None:
+        """Reset every VALUE-derived device cache after the serve layer
+        (cyclonus_tpu/serve) patches the packed buffer in place.  Shapes
+        are unchanged by contract, so the compiled programs — unpack,
+        grid/counts kernels, pairs — all stay valid and are reused; the
+        precompute / slab-operand pins and the device tensor views are
+        stale data and must rebuild from the patched buffer (device-side
+        work only: no host re-encode, no re-device_put of the buffer).
+        The slab plan's per-tile window proof is churn-stale too, so the
+        slab path stays disabled until the next full rebuild."""
+        self._device_tensors = None
+        self._class_device_tensors = None
+        self._class_of_dev = None
+        self._pre_cache = None
+        self._pre_cache_misses = 0
+        self._pre_cache_declined = None
+        self._last_counts_key = None
+        ti.PRE_CACHE_BYTES.set(0)
+        with self._slab_lock:
+            self._slab_choice = None
+            self._slab_ops_cache = None
+        self._slab_plan_state = None
+        self._selpod_prebucket = None
+        # ns-sort permutation: pod ns ids may have changed; [N] int32 is
+        # re-uploaded lazily (a touched index vector, not a slab)
+        self._pod_perm_dev = None
+        self._pod_perm_host = None
 
     def _build_tensors(self) -> Dict:
         enc = self.encoding
@@ -922,7 +987,9 @@ class TpuPolicyEngine:
                 buf = self._packed_transfer(
                     "_class_packed_buf", "_class_unpack", st["ctensors"]
                 )
-                self._class_device_tensors = jax.jit(self._class_unpack)(buf)
+                if self._class_unpack_jit is None:
+                    self._class_unpack_jit = jax.jit(self._class_unpack)
+                self._class_device_tensors = self._class_unpack_jit(buf)
             tensors = dict(self._class_device_tensors)
         else:
             tensors = dict(st["ctensors"])
@@ -1176,7 +1243,9 @@ class TpuPolicyEngine:
 
             if self._device_tensors is None:
                 buf = self._ensure_packed()
-                self._device_tensors = jax.jit(self._unpack)(buf)
+                if self._unpack_jit is None:
+                    self._unpack_jit = jax.jit(self._unpack)
+                self._device_tensors = self._unpack_jit(buf)
             tensors = dict(self._device_tensors)
         else:
             tensors = dict(self._tensors)

@@ -731,6 +731,84 @@ class PodClasses:
     signature_bytes: int = 0
 
 
+def encode_pod_rows(
+    pods: Sequence[Tuple[str, str, Dict[str, str], str]],
+    vocab: _Vocab,
+    l_width: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Encode pod tuples against an EXISTING vocab into fixed-width rows:
+    (pod_ns_id [k], pod_kv [k, l_width], pod_key, pod_ip, pod_ip_valid).
+
+    The delta path (cyclonus_tpu/serve) re-encodes ONLY the touched pod
+    rows: the vocab grows monotonically (a label pair/key/namespace new
+    to the cluster gets a fresh id, which by construction equals no
+    selector-referenced id, so it matches nothing — exactly the fresh-
+    rebuild semantics), and existing pairs resolve to their original
+    ids, so a patched row is bit-compatible with the rows around it.
+    Raises ValueError when a pod carries more labels than l_width — the
+    caller's signal to fall back to a full re-encode."""
+    k = len(pods)
+    ns_id = np.empty((k,), dtype=np.int32)
+    kv = np.full((k, max(l_width, 1)), -1, dtype=np.int32)
+    key = np.full((k, max(l_width, 1)), -1, dtype=np.int32)
+    for i, (ns, _name, labels, _ip) in enumerate(pods):
+        if len(labels) > l_width:
+            raise ValueError(
+                f"pod row needs {len(labels)} label slots, row width is "
+                f"{l_width} (full re-encode required)"
+            )
+        ns_id[i] = vocab.ns_id(ns)
+        # sorted(items) mirrors _encode_label_rows' within-row order
+        for j, (lk, lv) in enumerate(sorted(labels.items())):
+            kv[i, j] = vocab.kv_id(lk, lv)
+            key[i, j] = vocab.key_id(lk)
+    ip, ip_valid = _encode_pod_ips([p[3] for p in pods])
+    return ns_id, kv, key, ip, ip_valid
+
+
+def encode_ns_row(
+    labels: Dict[str, str], vocab: _Vocab, lns_width: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One namespace-label row (ns_kv, ns_key) of width lns_width against
+    an existing vocab; ValueError when the labels don't fit (full
+    re-encode required)."""
+    if len(labels) > lns_width:
+        raise ValueError(
+            f"namespace row needs {len(labels)} label slots, row width is "
+            f"{lns_width} (full re-encode required)"
+        )
+    kv = np.full((max(lns_width, 1),), -1, dtype=np.int32)
+    key = np.full((max(lns_width, 1),), -1, dtype=np.int32)
+    for j, (lk, lv) in enumerate(sorted(labels.items())):
+        kv[j] = vocab.kv_id(lk, lv)
+        key[j] = vocab.key_id(lk)
+    return kv, key
+
+
+def encode_directions(
+    policy: Policy, vocab: _Vocab
+) -> Tuple[
+    _DirectionEncoding,
+    _DirectionEncoding,
+    Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+    int,
+]:
+    """Encode both directions + the shared selector table of a compiled
+    Policy against `vocab` (grown in place).
+
+    This is the rule-slab half of encode_policy, split out so the delta
+    path can re-encode a changed policy set against a LIVE engine's
+    vocabulary: selector/target/peer ids are assigned fresh (they are
+    slab-local), while label/namespace/port ids resolve through the
+    shared vocab so the existing pod rows keep matching."""
+    sel_table = _SelectorTable()
+    ingress_targets, egress_targets = policy.sorted_targets()
+    ingress = _encode_direction(ingress_targets, sel_table, vocab)
+    egress = _encode_direction(egress_targets, sel_table, vocab)
+    sel_arrays = sel_table.encode(vocab)
+    return ingress, egress, sel_arrays, len(sel_table.selectors)
+
+
 def _ip_signature_bits(tensors: Dict) -> Optional[np.ndarray]:
     """[N, ceil(B/8)] uint8 packed per-pod IP-observability bits, or None
     when no rule observes pod IPs.
@@ -778,21 +856,18 @@ def _ip_signature_bits(tensors: Dict) -> Optional[np.ndarray]:
     return np.packbits(bits, axis=0).T  # [N, ceil(B/8)]
 
 
-def compute_pod_classes(tensors: Dict, selpod: np.ndarray) -> PodClasses:
-    """Bucket pods into label-equivalence classes.
+def pod_signatures(tensors: Dict, selpod: np.ndarray) -> np.ndarray:
+    """[N, K] uint8 packed per-pod observability signatures: ns id bytes
+    + packed selector-match bits + the IP-membership bits (see the class-
+    compression design note above).  Pods with equal rows are
+    indistinguishable to every rule.
 
-    `tensors` is the engine tensor dict BEFORE shape bucketing (real pod
-    rows only); `selpod` the [S, N] host selector-match matrix over the
-    same rows (api._selector_pod_matches_host — the identical pass that
-    feeds dead-target compaction).  Pure numpy: one packed signature
-    matrix, one np.unique over its void view."""
+    The delta path recomputes SINGLE rows of this matrix (one-pod
+    `tensors` view + the pod's [S, 1] selpod column) to patch class
+    membership without a full classify pass; the row width K depends
+    only on the selector count and the distinct ip-peer specs, so it is
+    stable across pod-only deltas."""
     n = int(tensors["pod_ns_id"].shape[0])
-    if n == 0:
-        z = np.zeros((0,), dtype=np.int32)
-        return PodClasses(
-            n_pods=0, n_classes=0, class_of_pod=z,
-            class_rep=z.copy(), class_size=z.copy(),
-        )
     blocks = [
         np.ascontiguousarray(
             tensors["pod_ns_id"].astype(np.int32, copy=False).reshape(n, 1)
@@ -807,7 +882,20 @@ def compute_pod_classes(tensors: Dict, selpod: np.ndarray) -> PodClasses:
     ip_bits = _ip_signature_bits(tensors)
     if ip_bits is not None:
         blocks.append(ip_bits)
-    buf = np.ascontiguousarray(np.concatenate(blocks, axis=1))
+    return np.ascontiguousarray(np.concatenate(blocks, axis=1))
+
+
+def classes_from_signatures(buf: np.ndarray) -> PodClasses:
+    """PodClasses from a [N, K] signature matrix: one np.unique over the
+    void row view (shared by the build-time classify and the delta
+    path's class rebuild)."""
+    n = int(buf.shape[0])
+    if n == 0:
+        z = np.zeros((0,), dtype=np.int32)
+        return PodClasses(
+            n_pods=0, n_classes=0, class_of_pod=z,
+            class_rep=z.copy(), class_size=z.copy(),
+        )
     rows = buf.view(np.dtype((np.void, buf.shape[1]))).reshape(n)
     _, rep, inv, counts = np.unique(
         rows, return_index=True, return_inverse=True, return_counts=True
@@ -820,6 +908,20 @@ def compute_pod_classes(tensors: Dict, selpod: np.ndarray) -> PodClasses:
         class_size=counts.astype(np.int32).reshape(-1),
         signature_bytes=int(buf.shape[1]),
     )
+
+
+def compute_pod_classes(tensors: Dict, selpod: np.ndarray) -> PodClasses:
+    """Bucket pods into label-equivalence classes.
+
+    `tensors` is the engine tensor dict BEFORE shape bucketing (real pod
+    rows only); `selpod` the [S, N] host selector-match matrix over the
+    same rows (api._selector_pod_matches_host — the identical pass that
+    feeds dead-target compaction).  Pure numpy: one packed signature
+    matrix, one np.unique over its void view."""
+    n = int(tensors["pod_ns_id"].shape[0])
+    if n == 0:
+        return classes_from_signatures(np.zeros((0, 1), dtype=np.uint8))
+    return classes_from_signatures(pod_signatures(tensors, selpod))
 
 
 def gather_class_pod_rows(tensors: Dict, class_rep: np.ndarray) -> Dict:
@@ -945,15 +1047,11 @@ def encode_policy(
     """Compile (policy, cluster) to tensors.  The selector/label vocabulary
     is built jointly so every selector-referenced pair has an id."""
     vocab = _Vocab()
-    sel_table = _SelectorTable()
-
-    ingress_targets, egress_targets = policy.sorted_targets()
-    ingress = _encode_direction(ingress_targets, sel_table, vocab)
-    egress = _encode_direction(egress_targets, sel_table, vocab)
-
+    ingress, egress, sel_arrays, n_selectors = encode_directions(
+        policy, vocab
+    )
     cluster = encode_cluster(pods, namespaces, vocab=vocab)
-
-    sel_req_kv, sel_exp_op, sel_exp_key, sel_exp_vals = sel_table.encode(vocab)
+    sel_req_kv, sel_exp_op, sel_exp_key, sel_exp_vals = sel_arrays
     return PolicyEncoding(
         cluster=cluster,
         ingress=ingress,
@@ -962,5 +1060,5 @@ def encode_policy(
         sel_exp_op=sel_exp_op,
         sel_exp_key=sel_exp_key,
         sel_exp_vals=sel_exp_vals,
-        n_selectors=len(sel_table.selectors),
+        n_selectors=n_selectors,
     )

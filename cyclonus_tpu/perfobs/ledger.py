@@ -159,6 +159,16 @@ def _bench_run_from_parsed(
     cc = detail.get("class_compression")
     if isinstance(cc, dict) and isinstance(cc.get("ratio"), (int, float)):
         run.class_compression_ratio = float(cc["ratio"])
+    serve = detail.get("serve")
+    if isinstance(serve, dict):
+        if isinstance(serve.get("incremental_apply_s"), (int, float)):
+            run.serve_incremental_apply_s = float(
+                serve["incremental_apply_s"]
+            )
+        if isinstance(serve.get("full_rebuild_s"), (int, float)):
+            run.serve_full_rebuild_s = float(serve["full_rebuild_s"])
+        if isinstance(serve.get("queries_per_sec"), (int, float)):
+            run.serve_queries_per_sec = float(serve["queries_per_sec"])
     mesh = detail.get("mesh_scaling") or {}
     rows = [
         r

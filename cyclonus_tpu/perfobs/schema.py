@@ -77,6 +77,14 @@ class PerfRun:
     # or compression inactive).  The sentinel WARNS (never fails) when
     # it degrades >2x vs the baseline best on the same workload.
     class_compression_ratio: Optional[float] = None
+    # detail.serve — the verdict-service churn leg (None: leg skipped
+    # or an older artifact).  Warn-only in the sentinel for now, like
+    # class_compression_ratio: the leg's own hard assertions (strict
+    # incremental mode + the differential gate) already fail the bench
+    # on correctness, so these fields gate only trends.
+    serve_incremental_apply_s: Optional[float] = None
+    serve_full_rebuild_s: Optional[float] = None
+    serve_queries_per_sec: Optional[float] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -100,6 +108,9 @@ class PerfRun:
             "telemetry_counters": dict(self.telemetry_counters),
             "retries": dict(self.retries),
             "class_compression_ratio": self.class_compression_ratio,
+            "serve_incremental_apply_s": self.serve_incremental_apply_s,
+            "serve_full_rebuild_s": self.serve_full_rebuild_s,
+            "serve_queries_per_sec": self.serve_queries_per_sec,
             "error": self.error,
             "metric": self.metric,
         }

@@ -37,11 +37,15 @@ from .ledger import Ledger
 from .schema import PerfRun
 
 #: phases the generic per-phase rule skips: warmup/eval have dedicated
-#: metrics (one regression, one finding), and backend_init_join is an
+#: metrics (one regression, one finding), backend_init_join is an
 #: INFRA wait (attach time on a cold/contended tunnel) — gating it as
 #: an engine regression would recreate the r03/r04 confusion; the
-#: cold-start forensics and failure classes cover it instead
-_DEDICATED_PHASES = frozenset({"warmup", "eval", "backend_init_join"})
+#: cold-start forensics and failure classes cover it instead — and
+#: serve_churn has its own warn-only fields (serve_incremental_apply_s
+#: / serve_queries_per_sec) whose workload knobs may differ per round
+_DEDICATED_PHASES = frozenset(
+    {"warmup", "eval", "backend_init_join", "serve_churn"}
+)
 
 
 @dataclass
@@ -259,6 +263,46 @@ def gate(
                 f"{best_ratio:g} — reported only (warn, not fail); "
                 "check the encoding/class signature before the next "
                 "large-cluster run"
+            )
+
+    # --- verdict-service churn leg: WARN, never fail --------------------
+    # new fields ride warn-only first (like class_compression_ratio):
+    # the serve leg's own hard assertions already fail the bench on
+    # correctness, and the leg's workload knobs (BENCH_SERVE_*) may
+    # legitimately differ across rounds — a degradation is a note, and
+    # these graduate to gated bounds once a few healthy rounds exist
+    apply_base = [
+        r.serve_incremental_apply_s
+        for r in baselines
+        if isinstance(r.serve_incremental_apply_s, (int, float))
+    ]
+    if apply_base and isinstance(
+        candidate.serve_incremental_apply_s, (int, float)
+    ):
+        best_apply = min(apply_base)
+        if candidate.serve_incremental_apply_s > 2.0 * best_apply:
+            notes.append(
+                "WARNING: serve_incremental_apply_s degraded >2x vs "
+                f"baseline: candidate "
+                f"{candidate.serve_incremental_apply_s:g}s vs best "
+                f"{best_apply:g}s — reported only (warn, not fail); "
+                "check the serve patch path before the next round"
+            )
+    qps_base = [
+        r.serve_queries_per_sec
+        for r in baselines
+        if isinstance(r.serve_queries_per_sec, (int, float))
+    ]
+    if qps_base and isinstance(
+        candidate.serve_queries_per_sec, (int, float)
+    ):
+        best_qps = max(qps_base)
+        if candidate.serve_queries_per_sec < best_qps / 2.0:
+            notes.append(
+                "WARNING: serve_queries_per_sec degraded >2x vs "
+                f"baseline: candidate "
+                f"{candidate.serve_queries_per_sec:g}/s vs best "
+                f"{best_qps:g}/s — reported only (warn, not fail)"
             )
 
     # --- per-phase bounds: every phase both sides know ------------------

@@ -1,0 +1,25 @@
+"""cyclonus_tpu.serve — the long-running verdict service (docs/DESIGN.md
+"Verdict service").
+
+A production controller sees a STREAM of pod/label/policy events and
+must answer "is this flow allowed" continuously; this package turns the
+batch engine into that controller: `VerdictService` holds authoritative
+cluster state + a delta queue, `IncrementalEngine` patches the live
+device-resident encoding row/slab-wise (falling back to a full rebuild
+past the churn threshold or the HBM patch budget), and `loop.run_stdio`
+speaks the worker wire protocol's Batch envelope with the optional
+Deltas/Queries/Verdict extensions (worker/model.py).  The differential
+gate — incremental engine vs fresh rebuild vs scalar oracle,
+bit-identical — lives on `VerdictService.verify_parity`.
+"""
+
+from .incremental import IncrementalEngine, Ineligible
+from .loop import run_stdio
+from .service import VerdictService
+
+__all__ = [
+    "IncrementalEngine",
+    "Ineligible",
+    "VerdictService",
+    "run_stdio",
+]

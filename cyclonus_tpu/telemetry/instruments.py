@@ -181,6 +181,65 @@ TUNNEL_PROBE_ATTEMPTS = REGISTRY.counter(
     labelnames=("outcome",),
 )
 
+# --- verdict service (cyclonus_tpu/serve) --------------------------------
+
+SERVE_EPOCH = REGISTRY.gauge(
+    "cyclonus_tpu_serve_epoch",
+    "Verdict service: applied delta-batch generation of the live engine.",
+)
+SERVE_PENDING = REGISTRY.gauge(
+    "cyclonus_tpu_serve_pending_deltas",
+    "Verdict service: deltas submitted but not yet applied.",
+)
+SERVE_STALENESS = REGISTRY.gauge(
+    "cyclonus_tpu_serve_staleness_seconds",
+    "Verdict service: age of the oldest pending delta (0 = engine is "
+    "current).",
+)
+SERVE_DELTAS = REGISTRY.counter(
+    "cyclonus_tpu_serve_deltas_total",
+    "Verdict service: deltas submitted.",
+)
+SERVE_APPLIES = REGISTRY.counter(
+    "cyclonus_tpu_serve_applies_total",
+    "Verdict service: apply batches, by mode (incremental = row/slab "
+    "patch of the live buffer; class_rebuild = patch + class-state "
+    "rebuild; full = re-encode + re-device_put; noop = state already "
+    "current).",
+    labelnames=("mode",),
+)
+SERVE_FALLBACKS = REGISTRY.counter(
+    "cyclonus_tpu_serve_fallbacks_total",
+    "Verdict service: incremental applies that fell back to a full "
+    "rebuild, by reason.",
+    labelnames=("reason",),
+)
+SERVE_REJECTED = REGISTRY.counter(
+    "cyclonus_tpu_serve_rejected_deltas_total",
+    "Verdict service: malformed deltas rejected at validation (reported "
+    "back on the wire, never applied) — distinct from fallbacks, which "
+    "count rebuilds of VALID batches.",
+)
+SERVE_PATCH_BYTES = REGISTRY.counter(
+    "cyclonus_tpu_serve_patch_bytes_total",
+    "Verdict service: bytes scatter-patched into live device buffers "
+    "(the incremental path's entire host->device traffic).",
+)
+SERVE_QUERIES = REGISTRY.counter(
+    "cyclonus_tpu_serve_queries_total",
+    "Verdict service: flow queries answered.",
+)
+SERVE_QUERY_LATENCY = REGISTRY.histogram(
+    "cyclonus_tpu_serve_query_latency_seconds",
+    "Verdict service: per-flow query latency, batch-amortized (the "
+    "p50/p99 surfaced by /state and the bench serve detail).",
+)
+SERVE_APPLY_SECONDS = REGISTRY.histogram(
+    "cyclonus_tpu_serve_apply_seconds",
+    "Verdict service: delta-apply spans, by mode.",
+    labelnames=("mode",),
+)
+
 # --- real-probe latency --------------------------------------------------
 
 PROBE_LATENCY = REGISTRY.histogram(

@@ -244,10 +244,17 @@ class MetricRegistry:
 
     # runtime twin of the guarded-by contract (tools/locklint.py LK001)
     _metrics = guards.Guarded("_lock")
+    _collectors = guards.Guarded("_lock")
 
     def __init__(self) -> None:
         self._lock = guards.lock()
         self._metrics: Dict[str, Metric] = {}  # guarded-by: self._lock
+        # pull-style refreshers (weakrefs to bound methods) run before
+        # every snapshot/render: gauges whose value is derived from live
+        # object state (e.g. serve staleness = now - oldest_pending) stay
+        # fresh at scrape time instead of freezing at their last
+        # event-driven write
+        self._collectors: List = []  # guarded-by: self._lock
 
     def _register(self, cls, name: str, help: str, labelnames, **kw) -> Metric:
         with self._lock:
@@ -293,9 +300,43 @@ class MetricRegistry:
             Histogram, name, help, labelnames, buckets=buckets
         )
 
+    def register_collector(self, method) -> None:
+        """Register a pull-style refresher: `method` (a BOUND method —
+        held by weakref, so a dead owner is pruned, never pinned) is
+        called before every snapshot()/render_prometheus().  It should
+        only set gauges and must not scrape."""
+        import weakref
+
+        with self._lock:
+            self._collectors.append(weakref.WeakMethod(method))
+
+    def _run_collectors(self) -> None:
+        """Refresh pull-style gauges.  Collectors run OUTSIDE the
+        registry lock (they take metric locks via Gauge.set, and may
+        take their owner's lock first) so the only nested acquisition
+        stays reset()'s registry->metric edge."""
+        with self._lock:
+            refs = list(self._collectors)
+        dead = []
+        for r in refs:
+            fn = r()
+            if fn is None:
+                dead.append(r)
+                continue
+            try:
+                fn()
+            except Exception:
+                pass  # a broken collector must not break the scrape
+        if dead:
+            with self._lock:
+                self._collectors = [
+                    r for r in self._collectors if r not in dead
+                ]
+
     def render_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4, families sorted by
         name, series sorted by labels — byte-stable for golden tests."""
+        self._run_collectors()
         with self._lock:
             families = sorted(self._metrics.items())
         lines: List[str] = []
@@ -304,6 +345,7 @@ class MetricRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> Dict[str, Any]:
+        self._run_collectors()
         with self._lock:
             families = sorted(self._metrics.items())
         return {name: metric.snapshot() for name, metric in families}

@@ -12,6 +12,11 @@ it) and serves, on a daemon thread:
                        artifact path as JSON (open in TensorBoard/XProf)
     /healthz           200 ok
 
+Extension routes registered via `register_route(path, fn)` serve JSON
+from the same thread — `cyclonus-tpu serve` adds /state (engine epoch,
+pending-delta depth, staleness) and /query (curl-able single-flow
+verdict) this way.
+
 Used by `probe`/`generate`/the worker via `--metrics-port`.  Stdlib-only
 by design (the container bakes no Prometheus client), and the thread is
 a daemon, so a finished CLI run never hangs on it.  A port that is
@@ -40,6 +45,32 @@ PROFILE_MAX_SECONDS = 60.0
 
 class MetricsPortBusy(RuntimeError):
     """The requested metrics port is already bound by another process."""
+
+
+_ROUTES_LOCK = threading.Lock()
+# extension routes: path -> fn(query_dict) -> (payload_dict, status).
+# The verdict service registers /state and /query here so the serve
+# engine's epoch/staleness/pending surface rides the SAME stdlib http
+# thread (and MetricsPortBusy handling) as /metrics.
+_ROUTES: dict = {}  # guarded-by: _ROUTES_LOCK
+
+
+def register_route(path: str, fn) -> None:
+    """Register an extension GET route: fn(query: dict) -> (payload,
+    http_status).  Replaces any previous handler at `path`; built-in
+    endpoints cannot be shadowed (do_GET checks them first)."""
+    with _ROUTES_LOCK:
+        _ROUTES[path] = fn
+
+
+def unregister_route(path: str) -> None:
+    with _ROUTES_LOCK:
+        _ROUTES.pop(path, None)
+
+
+def _route_for(path: str):
+    with _ROUTES_LOCK:
+        return _ROUTES.get(path)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -76,7 +107,15 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             self._send(b"ok\n", "text/plain")
         else:
-            self._send(b"not found\n", "text/plain", 404)
+            fn = _route_for(path)
+            if fn is None:
+                self._send(b"not found\n", "text/plain", 404)
+                return
+            try:
+                payload, code = fn(parse_qs(parsed.query))
+            except Exception as e:  # a broken handler must answer
+                payload, code = {"error": f"{type(e).__name__}: {e}"}, 500
+            self._send_json(payload, code)
 
     def _profile(self, query: dict) -> None:
         """On-demand device profiling: wrap a sleep of ?seconds=N in

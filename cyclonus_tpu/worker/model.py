@@ -17,9 +17,14 @@ directions are asserted by tests/test_worker.py):
     driver's cyclonus_tpu_probe_latency_seconds histogram),
     Batch.TraceId + Batch.ParentSpan (driver->worker trace context:
     the worker records its spans under the driver's trace id, nested
-    under the driver's span path), and Result.TraceEvents (the worker's
+    under the driver's span path), Result.TraceEvents (the worker's
     recorded events riding back to the driver for the merged timeline —
-    telemetry/events.py).
+    telemetry/events.py), and the verdict-service messages
+    Batch.Deltas + Batch.Queries (cyclonus_tpu/serve): a driver streams
+    Delta / FlowQuery payloads to a `cyclonus-tpu serve` process on the
+    SAME envelope, and the service answers with Verdict dicts.  An old
+    worker receiving a serve batch simply ignores the unknown keys and
+    probes the (empty) Requests list; an old driver never emits them.
 """
 
 from __future__ import annotations
@@ -85,13 +90,187 @@ class Request:
 
 
 @dataclass
+class Delta:
+    """One cluster-state mutation for the verdict service
+    (cyclonus_tpu/serve): pod add/remove, pod or namespace label change,
+    policy create/update/delete.  `kind` selects which optional payload
+    keys are meaningful; unused ones stay unset (omitted on the wire)."""
+
+    KINDS: ClassVar[tuple] = (
+        "pod_add",       # Namespace/Name + Labels + Ip
+        "pod_remove",    # Namespace/Name
+        "pod_labels",    # Namespace/Name + Labels (full replacement)
+        "ns_labels",     # Namespace + Labels (full replacement)
+        "policy_upsert", # Namespace/Name + Policy (NetworkPolicy dict)
+        "policy_delete", # Namespace/Name
+    )
+
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
+        "Kind": contracts.wire(str),
+        "Namespace": contracts.wire(str),
+        "Name": contracts.wire(str, optional=True),
+        "Labels": contracts.wire(dict, optional=True),
+        "Ip": contracts.wire(str, optional=True),
+        "Policy": contracts.wire(dict, optional=True),
+    }
+
+    kind: str
+    namespace: str
+    name: str = ""
+    labels: Optional[Dict[str, str]] = None
+    ip: Optional[str] = None
+    policy: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> dict:
+        d: Dict[str, Any] = {"Kind": self.kind, "Namespace": self.namespace}
+        if self.name:
+            d["Name"] = self.name
+        if self.labels is not None:
+            d["Labels"] = dict(self.labels)
+        if self.ip is not None:
+            d["Ip"] = self.ip
+        if self.policy is not None:
+            d["Policy"] = dict(self.policy)
+        if contracts.CHECK:
+            contracts.check_wire("Delta", d, self.WIRE)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Delta":
+        if contracts.CHECK:
+            contracts.check_wire("Delta", d, Delta.WIRE, partial=True)
+        labels = d.get("Labels")
+        policy = d.get("Policy")
+        return Delta(
+            kind=d.get("Kind", ""),
+            namespace=d.get("Namespace", ""),
+            name=d.get("Name", "") or "",
+            labels=dict(labels) if labels is not None else None,
+            ip=d.get("Ip"),
+            policy=dict(policy) if policy is not None else None,
+        )
+
+
+@dataclass
+class FlowQuery:
+    """One "is this flow allowed" question for the verdict service:
+    src/dst are pod keys ("namespace/name") known to the serving engine;
+    the (port, port_name, protocol) triple resolves exactly like an
+    engine PortCase."""
+
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
+        "Src": contracts.wire(str),
+        "Dst": contracts.wire(str),
+        "Port": contracts.wire(int),
+        "Protocol": contracts.wire(str),
+        "PortName": contracts.wire(str, optional=True),
+    }
+
+    src: str
+    dst: str
+    port: int
+    protocol: str
+    port_name: str = ""
+
+    def to_dict(self) -> dict:
+        d: Dict[str, Any] = {
+            "Src": self.src,
+            "Dst": self.dst,
+            "Port": self.port,
+            "Protocol": self.protocol,
+        }
+        if self.port_name:
+            d["PortName"] = self.port_name
+        if contracts.CHECK:
+            contracts.check_wire("FlowQuery", d, self.WIRE)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "FlowQuery":
+        if contracts.CHECK:
+            contracts.check_wire("FlowQuery", d, FlowQuery.WIRE, partial=True)
+        return FlowQuery(
+            src=d.get("Src", ""),
+            dst=d.get("Dst", ""),
+            port=int(d.get("Port", 0)),
+            protocol=d.get("Protocol", ""),
+            port_name=d.get("PortName", "") or "",
+        )
+
+
+@dataclass
+class Verdict:
+    """The verdict service's answer to one FlowQuery: the query echoed
+    back (responses may be reordered relative to a batch), the three
+    allow bits, and the engine epoch the answer was computed at (the
+    staleness anchor).  A query the engine cannot answer (unknown pod
+    key, bad protocol) carries Error and all-False bits."""
+
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
+        "Query": contracts.wire(dict),
+        "Ingress": contracts.wire(bool),
+        "Egress": contracts.wire(bool),
+        "Combined": contracts.wire(bool),
+        "Epoch": contracts.wire(int, optional=True),
+        "Error": contracts.wire(str, optional=True),
+        "LatencyMs": contracts.wire(float, optional=True),
+    }
+
+    query: FlowQuery
+    ingress: bool = False
+    egress: bool = False
+    combined: bool = False
+    epoch: Optional[int] = None
+    error: str = ""
+    latency_ms: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        d: Dict[str, Any] = {
+            "Query": self.query.to_dict(),
+            "Ingress": self.ingress,
+            "Egress": self.egress,
+            "Combined": self.combined,
+        }
+        if self.epoch is not None:
+            d["Epoch"] = self.epoch
+        if self.error:
+            d["Error"] = self.error
+        if self.latency_ms is not None:
+            d["LatencyMs"] = self.latency_ms
+        if contracts.CHECK:
+            contracts.check_wire("Verdict", d, self.WIRE)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Verdict":
+        if contracts.CHECK:
+            contracts.check_wire("Verdict", d, Verdict.WIRE, partial=True)
+        latency = d.get("LatencyMs")
+        return Verdict(
+            query=FlowQuery.from_dict(d.get("Query") or {}),
+            ingress=bool(d.get("Ingress", False)),
+            egress=bool(d.get("Egress", False)),
+            combined=bool(d.get("Combined", False)),
+            epoch=d.get("Epoch"),
+            error=d.get("Error", "") or "",
+            latency_ms=float(latency) if latency is not None else None,
+        )
+
+
+@dataclass
 class Batch:
     """model.go:9-24.
 
     trace_id / parent_span are OPTIONAL trace context (see the module
     docstring's compatibility rules): when the driver is recording a
     timeline, it stamps its trace id and current span path here so the
-    worker's spans join the same trace, nested under the issuing step."""
+    worker's spans join the same trace, nested under the issuing step.
+
+    deltas / queries are the OPTIONAL verdict-service payloads: a serve
+    batch rides the same envelope as a probe batch (Namespace/Pod/
+    Container may be empty there — the service is not pod-scoped), so
+    one stream can carry probes to workers and deltas/queries to the
+    service without a second protocol."""
 
     WIRE: ClassVar[Dict[str, contracts.WireField]] = {
         "Namespace": contracts.wire(str),
@@ -100,6 +279,8 @@ class Batch:
         "Requests": contracts.wire(list),
         "TraceId": contracts.wire(str, optional=True),
         "ParentSpan": contracts.wire(str, optional=True),
+        "Deltas": contracts.wire(list, optional=True),
+        "Queries": contracts.wire(list, optional=True),
     }
 
     namespace: str
@@ -108,6 +289,8 @@ class Batch:
     requests: List[Request] = field(default_factory=list)
     trace_id: str = ""
     parent_span: str = ""
+    deltas: List[Delta] = field(default_factory=list)
+    queries: List[FlowQuery] = field(default_factory=list)
 
     def key(self) -> str:
         return f"{self.namespace}/{self.pod}/{self.container}"
@@ -123,6 +306,10 @@ class Batch:
             d["TraceId"] = self.trace_id
             if self.parent_span:
                 d["ParentSpan"] = self.parent_span
+        if self.deltas:
+            d["Deltas"] = [x.to_dict() for x in self.deltas]
+        if self.queries:
+            d["Queries"] = [x.to_dict() for x in self.queries]
         if contracts.CHECK:
             contracts.check_wire("Batch", d, self.WIRE)
         return json.dumps(d)
@@ -140,6 +327,8 @@ class Batch:
             requests=[Request.from_dict(r) for r in d.get("Requests") or []],
             trace_id=d.get("TraceId", "") or "",
             parent_span=d.get("ParentSpan", "") or "",
+            deltas=[Delta.from_dict(x) for x in d.get("Deltas") or []],
+            queries=[FlowQuery.from_dict(x) for x in d.get("Queries") or []],
         )
 
 

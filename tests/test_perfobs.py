@@ -34,7 +34,8 @@ from cyclonus_tpu.perfobs import report as perf_report  # noqa: E402
 
 
 def healthy_line(
-    value=100e9, warmup=5.0, encode=1.0, mesh_rows=None, virtual=True
+    value=100e9, warmup=5.0, encode=1.0, mesh_rows=None, virtual=True,
+    serve=None,
 ):
     detail = {
         "build_s": 0.5,
@@ -74,6 +75,8 @@ def healthy_line(
             "virtual": virtual,
             "rows": mesh_rows,
         }
+    if serve is not None:
+        detail["serve"] = serve
     return {
         "metric": "simulated connectivity cells/sec (bench)",
         "value": value,
@@ -505,6 +508,93 @@ class TestGate:
 
 
 # --- report + Prometheus golden ------------------------------------------
+
+
+def serve_detail(apply_s=0.003, rebuild_s=1.2, qps=5000.0):
+    return {
+        "pods": 1024,
+        "policies": 128,
+        "deltas": 32,
+        "full_rebuild_s": rebuild_s,
+        "incremental_apply_s": apply_s,
+        "queries_per_sec": qps,
+        "no_reencode": True,
+    }
+
+
+class TestServeFields:
+    """detail.serve rides every BENCH line; the ledger parses the three
+    trend fields and the sentinel treats them WARN-ONLY (the serve leg's
+    own assertions are the hard gate)."""
+
+    def _ledger(self, *docs, tmp_path):
+        return load_ledger(write_rounds(tmp_path, list(docs)))
+
+    def test_ledger_parses_serve_fields(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(serve=serve_detail())), tmp_path=tmp_path
+        )
+        run = led.runs[0]
+        assert run.serve_incremental_apply_s == 0.003
+        assert run.serve_full_rebuild_s == 1.2
+        assert run.serve_queries_per_sec == 5000.0
+        # and the fields round-trip through the PerfRun dict form
+        from cyclonus_tpu.perfobs.schema import PerfRun
+
+        again = PerfRun.from_dict(run.to_dict())
+        assert again.serve_incremental_apply_s == 0.003
+
+    def test_ledger_without_serve_is_none(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line()), tmp_path=tmp_path
+        )
+        assert led.runs[0].serve_incremental_apply_s is None
+        assert led.runs[0].serve_queries_per_sec is None
+
+    def test_serve_degradation_warns_never_fails(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(serve=serve_detail(apply_s=0.002,
+                                                    qps=8000.0))),
+            wrap(2, healthy_line(serve=serve_detail(apply_s=0.003,
+                                                    qps=7000.0))),
+            wrap(3, healthy_line(value=120e9,
+                                 serve=serve_detail(apply_s=0.02,
+                                                    qps=1000.0))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        report = result.report()
+        assert "serve_incremental_apply_s degraded" in report
+        assert "serve_queries_per_sec degraded" in report
+        assert "warn, not fail" in report
+
+    def test_serve_within_tolerance_no_warning(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(serve=serve_detail(apply_s=0.002,
+                                                    qps=8000.0))),
+            wrap(2, healthy_line(value=110e9,
+                                 serve=serve_detail(apply_s=0.003,
+                                                    qps=6000.0))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass"
+        assert "serve_" not in result.report()
+
+    def test_serve_churn_phase_not_generically_gated(self, tmp_path):
+        # a slow serve_churn phase must not trip the per-phase rule —
+        # the leg's knobs (BENCH_SERVE_*) legitimately vary per round
+        base = healthy_line()
+        slow = healthy_line(value=120e9)
+        base["detail"]["phase_history_s"].append(["serve_churn", 1.0])
+        slow["detail"]["phase_history_s"].append(["serve_churn", 60.0])
+        led = self._ledger(
+            wrap(1, base), wrap(2, healthy_line()), wrap(3, slow),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
 
 
 class TestReport:

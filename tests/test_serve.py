@@ -1,0 +1,706 @@
+"""Verdict-service tests (cyclonus_tpu/serve): the seeded delta-stream
+property suite behind the differential correctness gate — after any
+fuzzed delta sequence (no-op deltas, delete-then-recreate, label flips
+that change PodClasses membership, namespace relabels, policy churn)
+the incrementally-updated engine must be BIT-IDENTICAL to a fresh
+rebuild and match the scalar oracle on the full truth table — plus the
+patch-no-rebuild telemetry assertions, the wire loop, and the /state
+//query HTTP surface."""
+
+import io
+import json
+import random
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cyclonus_tpu.engine.api import PortCase
+from cyclonus_tpu.kube.netpol import (
+    IntOrString,
+    IPBlock,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyEgressRule,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.kube.yaml_io import policy_to_dict
+from cyclonus_tpu.serve import VerdictService, run_stdio
+from cyclonus_tpu.telemetry import SPANS
+from cyclonus_tpu.telemetry import instruments as ti
+from cyclonus_tpu.worker.model import Batch, Delta, FlowQuery
+
+CASES = [
+    PortCase(80, "serve-80-tcp", "TCP"),
+    PortCase(81, "serve-81-udp", "UDP"),
+]
+
+APPS = ["a0", "a1", "a2"]
+TIERS = ["web", "db"]
+NS = ["x", "y", "z"]
+
+
+def mk_cluster(n_pods=15):
+    namespaces = {ns: {"ns": ns} for ns in NS}
+    pods = []
+    for i in range(n_pods):
+        ns = NS[i % len(NS)]
+        labels = {"app": APPS[i % len(APPS)], "tier": TIERS[i % len(TIERS)]}
+        pods.append((ns, f"p{i}", labels, f"10.0.{i // 250}.{i % 250 + 1}"))
+    return pods, namespaces
+
+
+def mk_policy(name, ns, rng):
+    sel = LabelSelector.make(match_labels={"app": rng.choice(APPS)})
+    if rng.random() < 0.25:
+        peer = NetworkPolicyPeer(
+            ip_block=IPBlock.make("10.0.0.0/24", ["10.0.0.8/29"])
+        )
+    else:
+        peer = NetworkPolicyPeer(
+            pod_selector=LabelSelector.make(
+                match_labels={"tier": rng.choice(TIERS)}
+            ),
+            namespace_selector=(
+                LabelSelector.make(match_labels={"ns": rng.choice(NS)})
+                if rng.random() < 0.5
+                else None
+            ),
+        )
+    ports = [NetworkPolicyPort(protocol="TCP", port=IntOrString(80))]
+    if rng.random() < 0.5:
+        ports.append(
+            NetworkPolicyPort(protocol="UDP", port=IntOrString("serve-81-udp"))
+        )
+    types = ["Ingress"] if rng.random() < 0.7 else ["Ingress", "Egress"]
+    rule_i = NetworkPolicyIngressRule(ports=ports, from_=[peer])
+    rule_e = NetworkPolicyEgressRule(ports=ports, to=[peer])
+    return NetworkPolicy(
+        name=name,
+        namespace=ns,
+        spec=NetworkPolicySpec(
+            pod_selector=sel,
+            policy_types=types,
+            ingress=[rule_i],
+            egress=[rule_e] if "Egress" in types else [],
+        ),
+    )
+
+
+def random_delta(svc, rng):
+    """One random delta against the service's CURRENT state, spanning
+    every kind, including deliberate no-ops and class-membership label
+    flips."""
+    roll = rng.random()
+    pod_keys = list(svc.pods)
+    if roll < 0.30 and pod_keys:
+        key = rng.choice(pod_keys)
+        ns, name = key.split("/", 1)
+        cur = svc.pods[key]
+        if rng.random() < 0.2:
+            labels = dict(cur[2])  # deliberate no-op: resend current
+        else:
+            # label flip between EXISTING shapes: moves the pod between
+            # PodClasses without creating a new signature (usually)
+            labels = {"app": rng.choice(APPS), "tier": rng.choice(TIERS)}
+        return Delta(kind="pod_labels", namespace=ns, name=name, labels=labels)
+    if roll < 0.45:
+        i = rng.randrange(1000)
+        ns = rng.choice(NS)
+        return Delta(
+            kind="pod_add", namespace=ns, name=f"new{i}",
+            labels={"app": rng.choice(APPS), "tier": rng.choice(TIERS)},
+            ip=f"10.9.{i // 250}.{i % 250 + 1}",
+        )
+    if roll < 0.60 and pod_keys:
+        key = rng.choice(pod_keys + ["zz/nope"])  # sometimes a no-op
+        ns, name = key.split("/", 1)
+        return Delta(kind="pod_remove", namespace=ns, name=name)
+    if roll < 0.72:
+        ns = rng.choice(NS)
+        labels = {"ns": ns}
+        if rng.random() < 0.5:
+            labels["zone"] = rng.choice(["a", "b"])
+        return Delta(kind="ns_labels", namespace=ns, labels=labels)
+    if roll < 0.88:
+        name = f"pol{rng.randrange(4)}"
+        ns = rng.choice(NS)
+        pol = mk_policy(name, ns, rng)
+        return Delta(
+            kind="policy_upsert", namespace=ns, name=name,
+            policy=policy_to_dict(pol),
+        )
+    keys = list(svc.netpols) + ["x/nope"]
+    key = rng.choice(keys)
+    ns, name = key.split("/", 1)
+    return Delta(kind="policy_delete", namespace=ns, name=name)
+
+
+def oracle_full_table(svc):
+    """The scalar oracle over EVERY (case, src, dst) cell of the current
+    state, compared against the live (incrementally patched) engine."""
+    from cyclonus_tpu.analysis.oracle import oracle_verdicts, traffic_for_cell
+
+    pods = list(svc.pods.values())
+    namespaces = dict(svc.namespaces)
+    policy = svc._policy
+    eng = svc.engine
+    idx = {k: i for i, k in enumerate(eng.pod_keys)}
+    grid = eng.evaluate_grid(CASES)
+    ingress = np.asarray(grid.ingress)
+    egress = np.asarray(grid.egress)
+    combined = np.asarray(grid.combined)
+    for qi, case in enumerate(CASES):
+        for si, sp in enumerate(pods):
+            for di, dp in enumerate(pods):
+                want = oracle_verdicts(
+                    policy,
+                    traffic_for_cell(pods, namespaces, case, si, di),
+                )
+                gi = idx[f"{sp[0]}/{sp[1]}"]
+                gj = idx[f"{dp[0]}/{dp[1]}"]
+                got = (
+                    bool(ingress[qi, gj, gi]),
+                    bool(egress[qi, gi, gj]),
+                    bool(combined[qi, gi, gj]),
+                )
+                assert got == want, (
+                    f"oracle mismatch at {case} {sp[0]}/{sp[1]} -> "
+                    f"{dp[0]}/{dp[1]}: engine={got} oracle={want}"
+                )
+
+
+class TestDeltaStreamFuzz:
+    """The differential gate of the tentpole: incremental == fresh
+    rebuild (bit-identical truth tables) == scalar oracle, across
+    seeded random delta streams."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_fuzzed_stream(self, seed):
+        rng = random.Random(seed)
+        pods, namespaces = mk_cluster(15)
+        policies = [mk_policy(f"pol{i}", NS[i % 3], rng) for i in range(3)]
+        svc = VerdictService(pods, namespaces, policies)
+        for _step in range(6):
+            batch = [
+                random_delta(svc, rng)
+                for _ in range(rng.randrange(1, 4))
+            ]
+            svc.apply(batch)
+            svc.verify_parity(CASES, rng=rng, oracle_samples=8)
+        oracle_full_table(svc)
+        # the stream must actually exercise the incremental path
+        counts = svc.state()["applies"]
+        assert sum(counts.values()) >= 1
+
+    def test_delete_then_recreate(self):
+        rng = random.Random(42)
+        pods, namespaces = mk_cluster(9)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)]
+        )
+        key_ns, key_name = pods[2][0], pods[2][1]
+        svc.apply([
+            Delta(kind="pod_remove", namespace=key_ns, name=key_name),
+            Delta(kind="pod_add", namespace=key_ns, name=key_name,
+                  labels={"app": "a2", "tier": "db"}, ip="10.0.0.99"),
+        ])
+        svc.verify_parity(CASES)
+        # same-batch add+remove of a brand-new pod nets to nothing
+        svc.apply([
+            Delta(kind="pod_add", namespace="y", name="ghost",
+                  labels={"app": "a0"}, ip="10.0.0.98"),
+            Delta(kind="pod_remove", namespace="y", name="ghost"),
+        ])
+        assert "y/ghost" not in svc.pods
+        svc.verify_parity(CASES)
+        oracle_full_table(svc)
+
+    def test_fuzzed_stream_class_compressed(self):
+        """The same gate with the equivalence-class grid compression
+        FORCED on: label flips move pods between classes (or rebuild
+        the class state), and the compressed evaluators must stay
+        bit-identical to the fresh rebuild and the oracle."""
+        rng = random.Random(7)
+        pods, namespaces = mk_cluster(18)
+        policies = [mk_policy(f"pol{i}", NS[i % 3], rng) for i in range(2)]
+        svc = VerdictService(
+            pods, namespaces, policies, class_compress="1"
+        )
+        assert svc.engine.class_compression_stats()["active"]
+        for _step in range(5):
+            batch = [
+                random_delta(svc, rng)
+                for _ in range(rng.randrange(1, 3))
+            ]
+            svc.apply(batch)
+            svc.verify_parity(CASES, rng=rng, oracle_samples=8)
+        assert svc.engine.class_compression_stats()["active"]
+        oracle_full_table(svc)
+
+    def test_class_membership_move_in_place(self):
+        """A label flip onto an EXISTING signature of a non-representative
+        pod moves it between classes without a class rebuild."""
+        namespaces = {"x": {"ns": "x"}}
+        pods = [
+            ("x", f"p{i}", {"app": APPS[i % 2]}, f"10.0.0.{i + 1}")
+            for i in range(8)
+        ]
+        rng = random.Random(3)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)],
+            class_compress="1",
+        )
+        # p4 shares a0's class with p0/p2/p6 — it is not the rep (p0 is)
+        before = svc.engine.class_compression_stats()["classes"]
+        r = svc.apply([
+            Delta(kind="pod_labels", namespace="x", name="p4",
+                  labels={"app": "a1"}),
+        ])
+        assert r["mode"] == "incremental", r
+        assert svc.engine.class_compression_stats()["classes"] == before
+        svc.verify_parity(CASES)
+
+
+class TestIncrementalTelemetry:
+    """The acceptance criterion: a single-pod delta patches the live
+    buffer — no full re-encode, no re-device_put of untouched slabs —
+    asserted via the engine span/telemetry counters."""
+
+    def test_single_pod_delta_does_not_reencode(self):
+        pods, namespaces = mk_cluster(24)
+        rng = random.Random(5)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)]
+        )
+        # warm the device state (packed transfer + pairs program)
+        svc.query([FlowQuery(src="x/p0", dst="y/p1", port=80,
+                             protocol="TCP", port_name="serve-80-tcp")])
+        stats = SPANS.stats()
+        encodes = stats.get("engine.encode", {}).get("count", 0)
+        device_puts = stats.get("engine.device_put", {}).get("count", 0)
+        full_before = ti.SERVE_APPLIES.value(mode="full")
+        patch_before = ti.SERVE_PATCH_BYTES.value()
+        r = svc.apply([
+            Delta(kind="pod_labels", namespace="x", name="p3",
+                  labels={"app": "a2", "tier": "db"}),
+        ])
+        assert r["mode"] == "incremental", r
+        stats = SPANS.stats()
+        assert stats.get("engine.encode", {}).get("count", 0) == encodes, (
+            "a single-pod delta must not re-encode the cluster"
+        )
+        assert (
+            stats.get("engine.device_put", {}).get("count", 0) == device_puts
+        ), "a single-pod delta must not re-device_put untouched slabs"
+        assert ti.SERVE_APPLIES.value(mode="full") == full_before
+        patched = ti.SERVE_PATCH_BYTES.value() - patch_before
+        assert 0 < patched <= 4096, (
+            f"patch should touch a few rows, moved {patched} bytes"
+        )
+        # and the patched engine still answers correctly
+        svc.verify_parity(CASES, oracle_samples=8)
+
+    def test_churn_threshold_falls_back_to_full(self, monkeypatch):
+        monkeypatch.setenv("CYCLONUS_SERVE_CHURN_ROWS", "0")
+        monkeypatch.setenv("CYCLONUS_SERVE_CHURN_FRAC", "0.0")
+        pods, namespaces = mk_cluster(9)
+        rng = random.Random(11)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)]
+        )
+        fallbacks = ti.SERVE_FALLBACKS.value(reason="ineligible")
+        r = svc.apply([
+            Delta(kind="pod_labels", namespace="x", name="p0",
+                  labels={"app": "a1"}),
+        ])
+        assert r["mode"] == "full"
+        assert ti.SERVE_FALLBACKS.value(reason="ineligible") == fallbacks + 1
+        svc.verify_parity(CASES)
+
+    def test_ipv6_ipblock_is_ineligible(self):
+        """Host-evaluated (IPv6) IPBlock rows force the full-rebuild
+        path — their per-pod match columns only rebuild host-side."""
+        namespaces = {"x": {"ns": "x"}}
+        pods = [("x", f"p{i}", {"app": "a0"}, f"10.0.0.{i + 1}")
+                for i in range(4)]
+        pol = NetworkPolicy(
+            name="v6", namespace="x",
+            spec=NetworkPolicySpec(
+                pod_selector=LabelSelector.make(match_labels={}),
+                policy_types=["Ingress"],
+                ingress=[NetworkPolicyIngressRule(
+                    ports=[],
+                    from_=[NetworkPolicyPeer(
+                        ip_block=IPBlock.make("fd00::/8", [])
+                    )],
+                )],
+            ),
+        )
+        svc = VerdictService(pods, namespaces, [pol])
+        r = svc.apply([
+            Delta(kind="pod_labels", namespace="x", name="p0",
+                  labels={"app": "a1"}),
+        ])
+        assert r["mode"] == "full"
+        svc.verify_parity(CASES)
+
+
+class TestMalformedDeltas:
+    def test_unknown_kind_rejected_without_divergence(self):
+        """A malformed delta mid-batch must be REJECTED up front — the
+        valid delta still applies, the engine stays consistent with the
+        dicts, and the reply names the rejection (a mid-batch raise
+        after mutation would silently diverge served verdicts)."""
+        pods, namespaces = mk_cluster(8)
+        rng = random.Random(6)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)]
+        )
+        r = svc.apply([
+            Delta(kind="pod_labels", namespace="x", name="p0",
+                  labels={"app": "a1", "tier": "db"}),
+            Delta(kind="pod_rename", namespace="x", name="p0"),
+            Delta(kind="policy_upsert", namespace="x", name="bad",
+                  policy={"spec": {"policyTypes": []}}),
+        ])
+        assert r["applied"] == 1 and len(r["rejected"]) == 2, r
+        assert "unknown delta kind" in r["rejected"][0]
+        # the valid delta landed and the engine matches the dicts
+        assert svc.pods["x/p0"][2]["app"] == "a1"
+        svc.verify_parity(CASES)
+        # the wire loop surfaces the rejections
+        out = io.StringIO()
+        run_stdio(
+            svc,
+            io.StringIO(Batch(
+                namespace="", pod="", container="",
+                deltas=[Delta(kind="nope", namespace="x", name="p1")],
+            ).to_json() + "\n"),
+            out,
+        )
+        reply = json.loads(out.getvalue())
+        assert reply["Applied"] == 0 and reply["Rejected"]
+
+    def test_pod_add_without_parseable_ip_rejected(self):
+        """A pod_add with a missing or unparseable Ip must be rejected
+        up front: committed, it would land in the engine's unparseable
+        set and make EVERY later query raise (malformed IPs raise by
+        design) — one bad delta must not take down the query surface of
+        a long-running service."""
+        namespaces = {"x": {"ns": "x"}}
+        pods = [("x", f"p{i}", {"app": "a0"}, f"10.0.0.{i + 1}")
+                for i in range(4)]
+        pol = NetworkPolicy(
+            name="ipb", namespace="x",
+            spec=NetworkPolicySpec(
+                pod_selector=LabelSelector.make(match_labels={}),
+                policy_types=["Ingress"],
+                ingress=[NetworkPolicyIngressRule(
+                    ports=[],
+                    from_=[NetworkPolicyPeer(
+                        ip_block=IPBlock.make("10.0.0.0/24", [])
+                    )],
+                )],
+            ),
+        )
+        svc = VerdictService(pods, namespaces, [pol])
+        r = svc.apply([
+            Delta(kind="pod_add", namespace="x", name="noip",
+                  labels={"app": "a0"}),
+            Delta(kind="pod_add", namespace="x", name="badip",
+                  labels={"app": "a0"}, ip="not-an-ip"),
+        ])
+        assert r["mode"] == "noop" and len(r["rejected"]) == 2, r
+        assert "x/noip" not in svc.pods and "x/badip" not in svc.pods
+        v = svc.query([FlowQuery(
+            src="x/p0", dst="x/p1", port=80, protocol="TCP",
+        )])[0]
+        assert not v.error
+        svc.verify_parity(CASES)
+
+    def test_policy_delete_empty_namespace_roundtrips(self):
+        """policy_delete must key policies the way policy_upsert stores
+        them: an empty namespace means 'default' on BOTH sides, so a
+        symmetric upsert/delete pair removes the policy instead of the
+        delete silently missing while the engine keeps enforcing it."""
+        pods, namespaces = mk_cluster(6)
+        svc = VerdictService(pods, namespaces, [])
+        rng = random.Random(17)
+        r = svc.apply([Delta(
+            kind="policy_upsert", namespace="", name="deny",
+            policy=policy_to_dict(mk_policy("deny", "", rng)),
+        )])
+        assert r["mode"] != "noop" and "default/deny" in svc.netpols
+        r = svc.apply([Delta(kind="policy_delete", namespace="", name="deny")])
+        assert r["mode"] != "noop", r
+        assert not svc.netpols
+        svc.verify_parity(CASES)
+
+    def test_rejected_deltas_count_separately_from_fallbacks(self):
+        """Malformed deltas are not fallbacks: they bump the dedicated
+        rejected counter and leave fallbacks_total alone (an operator
+        watching fallbacks to judge incremental-path health must not see
+        client garbage there)."""
+        pods, namespaces = mk_cluster(6)
+        svc = VerdictService(pods, namespaces, [])
+        rej0 = ti.SERVE_REJECTED.value()
+        fb0 = sum(
+            s.get("value", 0)
+            for s in (ti.SERVE_FALLBACKS.snapshot().get("samples") or [])
+        )
+        svc.apply([Delta(kind="nope", namespace="x", name="p0")])
+        assert ti.SERVE_REJECTED.value() == rej0 + 1
+        fb1 = sum(
+            s.get("value", 0)
+            for s in (ti.SERVE_FALLBACKS.snapshot().get("samples") or [])
+        )
+        assert fb1 == fb0
+
+    def test_apply_failure_rolls_back_batch(self, monkeypatch):
+        """A policy that validates solo but fails the FULL-SET compile
+        (the combination case validation cannot see) must not poison the
+        authoritative dicts: the batch rolls back atomically, the engine
+        stays consistent with the pre-batch state, and later applies
+        work — the service never goes permanently stale."""
+        pods, namespaces = mk_cluster(8)
+        rng = random.Random(11)
+        svc = VerdictService(pods, namespaces, [mk_policy("pol0", "x", rng)])
+        epoch0 = svc.state()["epoch"]
+        real = VerdictService._compiled_policy
+        calls = {"n": 0}
+
+        def boom(self):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("fails only in combination")
+            return real(self)
+
+        monkeypatch.setattr(VerdictService, "_compiled_policy", boom)
+        newpol = mk_policy("newpol", "x", rng)
+        delta = Delta(
+            kind="policy_upsert", namespace="x", name="newpol",
+            policy=policy_to_dict(newpol),
+        )
+        with pytest.raises(RuntimeError, match="in combination"):
+            svc.apply([delta])
+        # the batch never happened: dicts rolled back, epoch unchanged,
+        # engine bit-identical to a fresh build of the pre-batch state
+        assert "x/newpol" not in svc.netpols
+        assert svc.state()["epoch"] == epoch0
+        svc.verify_parity(CASES)
+        # the poison is gone: the same delta applies cleanly afterwards
+        r = svc.apply([delta])
+        assert r["mode"] in ("incremental", "class_rebuild", "full")
+        assert "x/newpol" in svc.netpols
+        svc.verify_parity(CASES)
+
+    def test_validation_compiles_under_live_simplify(self, monkeypatch):
+        """_validate_delta must prove compilability under the SERVICE's
+        simplify setting, not a hardcoded one — a policy that only fails
+        under simplify() is rejected up front instead of committed."""
+        import cyclonus_tpu.serve.service as service_mod
+
+        pods, namespaces = mk_cluster(4)
+        svc = VerdictService(pods, namespaces, [], simplify=True)
+        seen = []
+
+        def spy(simplify, pols):
+            seen.append(simplify)
+            return build_network_policies_real(simplify, pols)
+
+        build_network_policies_real = service_mod.build_network_policies
+        monkeypatch.setattr(service_mod, "build_network_policies", spy)
+        svc.apply([Delta(
+            kind="policy_upsert", namespace="x", name="polv",
+            policy=policy_to_dict(mk_policy("polv", "x", random.Random(3))),
+        )])
+        assert seen and all(s is True for s in seen), seen
+
+
+class TestQueries:
+    def test_query_grouping_and_epoch(self):
+        pods, namespaces = mk_cluster(10)
+        rng = random.Random(1)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)]
+        )
+        qs = [
+            FlowQuery(src="x/p0", dst="y/p1", port=80, protocol="TCP",
+                      port_name="serve-80-tcp"),
+            FlowQuery(src="y/p1", dst="x/p0", port=81, protocol="UDP",
+                      port_name="serve-81-udp"),
+            FlowQuery(src="x/p0", dst="gone/p9", port=80, protocol="TCP"),
+        ]
+        out = svc.query(qs)
+        assert len(out) == 3
+        assert out[2].error and not out[2].combined
+        assert all(v.epoch == 0 for v in out)
+        # verdicts agree with the scalar oracle
+        from cyclonus_tpu.analysis.oracle import (
+            oracle_verdicts,
+            traffic_for_cell,
+        )
+
+        plist = list(svc.pods.values())
+        keys = [f"{p[0]}/{p[1]}" for p in plist]
+        for v, q in zip(out[:2], qs[:2]):
+            case = PortCase(q.port, q.port_name, q.protocol)
+            want = oracle_verdicts(
+                svc._policy,
+                traffic_for_cell(
+                    plist, dict(svc.namespaces), case,
+                    keys.index(q.src), keys.index(q.dst),
+                ),
+            )
+            assert (v.ingress, v.egress, v.combined) == want
+
+    def test_query_latency_histogram_feeds_state(self):
+        pods, namespaces = mk_cluster(6)
+        svc = VerdictService(pods, namespaces, [])
+        svc.query([FlowQuery(src="x/p0", dst="y/p1", port=80,
+                             protocol="TCP")])
+        st = svc.state()
+        assert st["query_latency"]["count"] >= 1
+        assert st["query_latency"]["p50_s"] is not None
+        assert st["query_latency"]["p99_s"] >= st["query_latency"]["p50_s"]
+
+
+class TestWireLoop:
+    def test_stdio_roundtrip_in_process(self):
+        pods, namespaces = mk_cluster(8)
+        rng = random.Random(2)
+        svc = VerdictService(
+            pods, namespaces, [mk_policy("pol0", "x", rng)]
+        )
+        lines = [
+            Batch(
+                namespace="", pod="", container="",
+                deltas=[Delta(kind="pod_labels", namespace="x", name="p0",
+                              labels={"app": "a1", "tier": "db"})],
+                queries=[FlowQuery(src="x/p0", dst="x/p3", port=80,
+                                   protocol="TCP",
+                                   port_name="serve-80-tcp")],
+            ).to_json(),
+            "this is not json",
+            Batch(
+                namespace="", pod="", container="",
+                queries=[FlowQuery(src="x/p0", dst="x/p3", port=80,
+                                   protocol="TCP",
+                                   port_name="serve-80-tcp")],
+            ).to_json(),
+        ]
+        out = io.StringIO()
+        handled = run_stdio(svc, io.StringIO("\n".join(lines) + "\n"), out)
+        assert handled == 3
+        replies = [json.loads(x) for x in out.getvalue().splitlines()]
+        assert replies[0]["Applied"] == 1
+        assert replies[0]["Epoch"] == 1
+        assert len(replies[0]["Verdicts"]) == 1
+        assert "Error" in replies[1]
+        assert replies[2]["Verdicts"][0]["Epoch"] == 1
+        # a line's queries see its own deltas (read-your-writes):
+        # reply 0 and reply 2 answer identically
+        assert replies[0]["Verdicts"][0]["Combined"] == (
+            replies[2]["Verdicts"][0]["Combined"]
+        )
+        svc.verify_parity(CASES)
+
+    def test_serve_cli_subprocess(self):
+        """End-to-end: the `cyclonus-tpu serve` process over real pipes —
+        apply a delta batch, query, clean EOF shutdown."""
+        batch = Batch(
+            namespace="", pod="", container="",
+            deltas=[Delta(kind="pod_add", namespace="ns0", name="extra",
+                          labels={"app": "app1", "pod": "p1",
+                                  "tier": "tier1"},
+                          ip="10.99.0.1")],
+            queries=[FlowQuery(src="ns0/extra", dst="ns0/extra", port=80,
+                               protocol="TCP")],
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "cyclonus_tpu", "serve",
+             "--synthetic-pods", "12", "--synthetic-namespaces", "2",
+             "--max-lines", "1"],
+            input=batch.to_json() + "\n",
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        reply = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert reply["Applied"] == 1 and reply["Epoch"] == 1
+        (verdict,) = reply["Verdicts"]
+        # no policies: everything is allowed
+        assert verdict["Combined"] is True and not verdict.get("Error")
+
+
+class TestHttpSurface:
+    def test_state_and_query_routes(self):
+        from cyclonus_tpu.serve.service import register_http
+        from cyclonus_tpu.telemetry.server import (
+            start_metrics_server,
+            stop_metrics_server,
+            unregister_route,
+        )
+
+        pods, namespaces = mk_cluster(6)
+        svc = VerdictService(pods, namespaces, [])
+        srv = start_metrics_server(0)
+        try:
+            register_http(svc)
+            with urllib.request.urlopen(f"{srv.url}/state", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["epoch"] == 0 and st["pods"] == 6
+            assert "staleness_s" in st and "pending_deltas" in st
+            url = (
+                f"{srv.url}/query?src=x/p0&dst=y/p1&port=80&protocol=TCP"
+            )
+            with urllib.request.urlopen(url, timeout=10) as r:
+                v = json.loads(r.read())
+            assert v["Combined"] is True  # no policies: allowed
+            bad = f"{srv.url}/query?src=x/p0&dst=zz/none&port=80"
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                v = json.loads(e.read())
+                assert "unknown pod key" in v["Error"]
+        finally:
+            unregister_route("/state")
+            unregister_route("/query")
+            stop_metrics_server()
+
+    def test_staleness_gauge_fresh_at_scrape(self):
+        """The staleness gauge must age at SCRAPE time, not only when a
+        delta event or a /state call writes it: a driver that submits
+        without draining still shows the oldest pending delta's current
+        age on /metrics (the service registers a pull-style registry
+        collector)."""
+        import time
+
+        pods, namespaces = mk_cluster(4)
+        svc = VerdictService(pods, namespaces, [])
+        svc.submit([Delta(
+            kind="pod_labels", namespace="x", name="p0",
+            labels={"app": "a1"},
+        )])
+        time.sleep(0.06)
+
+        def gauge(snap, name):
+            return snap[name]["samples"][0]["value"]
+
+        snap = ti.REGISTRY.snapshot()
+        assert gauge(snap, "cyclonus_tpu_serve_pending_deltas") == 1
+        assert gauge(snap, "cyclonus_tpu_serve_staleness_seconds") >= 0.05
+        svc.apply_pending()
+        snap = ti.REGISTRY.snapshot()
+        assert gauge(snap, "cyclonus_tpu_serve_pending_deltas") == 0
+        assert gauge(snap, "cyclonus_tpu_serve_staleness_seconds") == 0.0

@@ -120,7 +120,14 @@ class TestWireCompatibility:
         contract on an optional field (or demoting a required one)
         fails here before it can ship a silent wire break.  Update the
         golden AND the module docstring together, never one alone."""
-        from cyclonus_tpu.worker.model import Batch, Request, Result
+        from cyclonus_tpu.worker.model import (
+            Batch,
+            Delta,
+            FlowQuery,
+            Request,
+            Result,
+            Verdict,
+        )
 
         golden = {
             Request: {
@@ -136,6 +143,8 @@ class TestWireCompatibility:
                 "Requests": (list, False),
                 "TraceId": (str, True),
                 "ParentSpan": (str, True),
+                "Deltas": (list, True),
+                "Queries": (list, True),
             },
             Result: {
                 "Request": (dict, False),
@@ -144,10 +153,107 @@ class TestWireCompatibility:
                 "LatencyMs": (float, True),
                 "TraceEvents": (list, True),
             },
+            Delta: {
+                "Kind": (str, False),
+                "Namespace": (str, False),
+                "Name": (str, True),
+                "Labels": (dict, True),
+                "Ip": (str, True),
+                "Policy": (dict, True),
+            },
+            FlowQuery: {
+                "Src": (str, False),
+                "Dst": (str, False),
+                "Port": (int, False),
+                "Protocol": (str, False),
+                "PortName": (str, True),
+            },
+            Verdict: {
+                "Query": (dict, False),
+                "Ingress": (bool, False),
+                "Egress": (bool, False),
+                "Combined": (bool, False),
+                "Epoch": (int, True),
+                "Error": (str, True),
+                "LatencyMs": (float, True),
+            },
         }
         for cls, want in golden.items():
             got = {k: (wf.type, wf.optional) for k, wf in cls.WIRE.items()}
             assert got == want, f"{cls.__name__} wire contract drifted"
+
+    def test_serve_messages_roundtrip(self):
+        """The verdict-service payloads (Deltas/Queries) ride the Batch
+        envelope as optional keys and round-trip exactly."""
+        from cyclonus_tpu.worker.model import Delta, FlowQuery, Verdict
+
+        b = make_batch(0)
+        b.deltas = [
+            Delta(kind="pod_add", namespace="x", name="p1",
+                  labels={"app": "a"}, ip="10.0.0.9"),
+            Delta(kind="ns_labels", namespace="y", labels={"team": "t"}),
+            Delta(kind="policy_delete", namespace="x", name="deny-all"),
+        ]
+        b.queries = [
+            FlowQuery(src="x/a", dst="y/b", port=80, protocol="TCP",
+                      port_name="serve-80-tcp"),
+            FlowQuery(src="x/a", dst="x/a", port=81, protocol="UDP"),
+        ]
+        b2 = Batch.from_json(b.to_json())
+        assert b2 == b
+        # unused optional payload keys are omitted per-delta
+        d = b.deltas[2].to_dict()
+        assert set(d) == {"Kind", "Namespace", "Name"}
+        v = Verdict(query=b.queries[0], ingress=True, egress=False,
+                    combined=False, epoch=7, latency_ms=0.5)
+        v2 = Verdict.from_dict(v.to_dict())
+        assert v2 == v
+        verr = Verdict(query=b.queries[1], error="unknown pod key")
+        assert Verdict.from_dict(verr.to_dict()) == verr
+        assert "Epoch" not in verr.to_dict()
+
+    def test_serve_batch_ignored_by_old_worker(self):
+        """Forward compat: a serve batch fed to the probe loop (an OLD
+        worker that predates Deltas/Queries would parse the same way —
+        unknown keys dropped, empty Requests) must answer cleanly with
+        zero results instead of crashing."""
+        import json as _json
+
+        from cyclonus_tpu.worker.model import Delta
+
+        b = make_batch(0)
+        b.deltas = [Delta(kind="pod_remove", namespace="x", name="p")]
+        raw = _json.loads(b.to_json())
+        # what an OLD peer sees: it reads only the keys it knows
+        legacy_view = {
+            k: raw[k] for k in ("Namespace", "Pod", "Container", "Requests")
+        }
+        out = run_worker(_json.dumps(legacy_view))
+        assert _json.loads(out) == []
+        # and the NEW parser round-trips the legacy view without deltas
+        assert Batch.from_json(_json.dumps(legacy_view)).deltas == []
+
+    def test_wire_drift_mutation_is_caught(self, monkeypatch):
+        """The drift-mutation half of the golden: with runtime checks on,
+        a PRESENT key whose type drifted from the WIRE declaration must
+        raise on parse — for the serve messages just like the probe
+        ones."""
+        from cyclonus_tpu.utils import contracts
+        from cyclonus_tpu.worker.model import Delta, FlowQuery, Verdict
+
+        monkeypatch.setattr(contracts, "CHECK", True)
+        with pytest.raises(contracts.ContractViolation):
+            Delta.from_dict({"Kind": "pod_add", "Namespace": "x",
+                             "Labels": ["not", "a", "dict"]})
+        with pytest.raises(contracts.ContractViolation):
+            FlowQuery.from_dict({"Src": "x/a", "Dst": "x/b",
+                                 "Port": "eighty", "Protocol": "TCP"})
+        with pytest.raises(contracts.ContractViolation):
+            Verdict.from_dict({"Query": {}, "Ingress": "yes",
+                               "Egress": False, "Combined": False})
+        # emit side: a required key missing fails the full check
+        with pytest.raises(contracts.ContractViolation):
+            contracts.check_wire("Delta", {"Namespace": "x"}, Delta.WIRE)
 
     def test_wire_contract_statically_linted(self):
         """shapelint's emit-side check runs over worker/model.py in
