@@ -23,12 +23,13 @@ lint: shapelint
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
-	  cyclonus_tpu/perfobs cyclonus_tpu/serve
+	  cyclonus_tpu/perfobs cyclonus_tpu/serve cyclonus_tpu/tiers
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
-	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve
+	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve \
+	  cyclonus_tpu/tiers
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -59,17 +60,29 @@ serve-smoke:
 
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
-# smoke the verdict service, then run the suite on a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed serve-smoke
+# smoke the verdict service, run the seeded tier fuzz gate, then run
+# the suite on a CPU 8-device mesh
+check: vet lint perf-gate parity-compressed serve-smoke fuzz
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
 conformance:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m conformance
 
-# opt-in: 100 extra randomized parity seeds through the grid kernel
-# and the xla/pallas counts engines
+# the precedence-tier differential fuzz gate (docs/DESIGN.md
+# "Precedence tiers"): seeded adversarial ANP/BANP policy sets —
+# overlapping priorities, Pass-chains, overlapping CIDRs, empty
+# selectors, sentinel-adjacent ports, endPort ranges, SCTP — checked
+# kernel-vs-scalar-lattice-oracle, dense AND class-compressed, plus the
+# generator's ANP/BANP conformance family.  Seeded and bounded (8
+# seeds) so it rides inside `make check`; a failure names the seed for
+# `cyclonus-tpu fuzz --seed N --seeds 1` reproduction.
 fuzz:
+	JAX_PLATFORMS=cpu python -m cyclonus_tpu fuzz --seeds 8 --conformance
+
+# opt-in: the tier gate above plus 100 extra randomized parity seeds
+# through the grid kernel and the xla/pallas counts engines
+fuzz-full: fuzz
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fuzz
 
 # opt-in: the extended schedule-fuzzing race sweep (tests/raceharness.py
@@ -96,4 +109,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz race bench fmt vet lint shapelint perf-gate parity-compressed serve-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench fmt vet lint shapelint perf-gate parity-compressed serve-smoke cyclonus docker

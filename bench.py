@@ -36,7 +36,11 @@ models the "thousands of pods, a handful of label shapes" regime the
 compression exists for; detail.mega_class.class_compression records
 pods/classes/ratio/gather_s).  Every line also records the HEADLINE
 engine's detail.class_compression (CYCLONUS_CLASS_COMPRESS governs the
-engine-side path selection).
+engine-side path selection), and detail.tiers — the precedence-tier leg
+(BENCH_TIERS=0 skips, BENCH_TIERS_PODS / BENCH_TIERS_POLICIES /
+BENCH_TIERS_SAMPLE size it): a deterministic ANP/BANP lattice over a
+synthetic cluster, recording {active, anp_count, rule_rows, banp,
+resolve_s} plus leg timings, with tiered-oracle spot parity enforced.
 
 On any failure — watchdog expiry, backend init timeout/error, or crash —
 the bench still prints one parseable JSON line with an "error" field, a
@@ -828,6 +832,154 @@ def _serve_churn_leg(cases, n_pods: int, n_policies: int):
     }
 
 
+def tiers_case(cases, headline_pods: int, headline_policies: int) -> dict:
+    """BENCH tiers leg (detail.tiers): the precedence-tier lattice on a
+    BENCH_TIERS_PODS-pod synthetic cluster under a deterministic
+    ANP/BANP set layered over BENCH_TIERS_POLICIES NetworkPolicies —
+    resolve_s is the tiered grid dispatch (engine.tier_stats), with a
+    scalar-oracle spot check on sampled cells so a wrong tier epilogue
+    can never publish a rate (docs/DESIGN.md "Precedence tiers")."""
+    import random as _random
+
+    from cyclonus_tpu.engine import TpuPolicyEngine
+    from cyclonus_tpu.kube.netpol import IntOrString, LabelSelector
+    from cyclonus_tpu.matcher import build_network_policies
+    from cyclonus_tpu.matcher.tiered import TieredPolicy
+    from cyclonus_tpu.tiers.model import (
+        AdminNetworkPolicy,
+        BaselineAdminNetworkPolicy,
+        TierPort,
+        TierRule,
+        TierScope,
+        TierSet,
+    )
+
+    n_pods = int(
+        os.environ.get("BENCH_TIERS_PODS", "0")
+    ) or min(1024, headline_pods)
+    n_policies = int(
+        os.environ.get("BENCH_TIERS_POLICIES", "0")
+    ) or min(32, max(headline_policies, 8))
+    rng = _random.Random(777)
+    pods, namespaces, pol_objs = build_synthetic(n_pods, n_policies, rng)
+    # deterministic lattice over build_synthetic's label scheme:
+    # overlapping priorities (two at 5), a Pass-chain into the NP tier,
+    # an endPort range, SCTP, and a BANP default-deny for one app
+    tiers = TierSet(
+        anps=[
+            AdminNetworkPolicy(
+                name="bench-deny-tier0", priority=5,
+                subject=TierScope(
+                    pod_selector=LabelSelector.make({"tier": "tier0"})
+                ),
+                ingress=[TierRule(
+                    action="Deny",
+                    peers=[TierScope(
+                        pod_selector=LabelSelector.make({"app": "app1"})
+                    )],
+                    ports=[TierPort(
+                        protocol="TCP", port=IntOrString(80), end_port=81
+                    )],
+                )],
+            ),
+            AdminNetworkPolicy(
+                name="bench-pass-tier1", priority=5,
+                subject=TierScope(
+                    pod_selector=LabelSelector.make({"tier": "tier1"})
+                ),
+                ingress=[TierRule(
+                    action="Pass", peers=[TierScope()],
+                )],
+            ),
+            AdminNetworkPolicy(
+                name="bench-allow-sctp", priority=9,
+                subject=TierScope(),
+                ingress=[TierRule(
+                    action="Allow",
+                    peers=[TierScope(
+                        namespace_selector=LabelSelector.make(
+                            {"team": "team0"}
+                        )
+                    )],
+                    ports=[TierPort(
+                        protocol="SCTP", port=IntOrString(82)
+                    )],
+                )],
+            ),
+        ],
+        banp=BaselineAdminNetworkPolicy(
+            subject=TierScope(
+                pod_selector=LabelSelector.make({"app": "app2"})
+            ),
+            ingress=[TierRule(action="Deny", peers=[TierScope()])],
+        ),
+    )
+    t0 = time.perf_counter()
+    policy = build_network_policies(True, pol_objs)
+    engine = TpuPolicyEngine(policy, pods, namespaces, tiers=tiers)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    grid = engine.evaluate_grid(cases)
+    warmup_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        grid = engine.evaluate_grid(cases)
+        times.append(time.perf_counter() - t0)
+    combined = np.asarray(grid.combined)
+    # spot differential: sampled cells against the tiered scalar oracle
+    from cyclonus_tpu.analysis.oracle import traffic_for_cell
+
+    oracle = TieredPolicy(policy, tiers)
+    n_samples = int(os.environ.get("BENCH_TIERS_SAMPLE", "16"))
+    for _ in range(n_samples):
+        qi = rng.randrange(len(cases))
+        si, di = rng.randrange(n_pods), rng.randrange(n_pods)
+        t = traffic_for_cell(pods, namespaces, cases[qi], si, di)
+        _ing, _eg, want = oracle.is_traffic_allowed(t)
+        got = bool(combined[qi, si, di])
+        if got != want:
+            raise AssertionError(
+                f"BENCH TIERS: kernel diverges from the tiered oracle "
+                f"at case={cases[qi]} src={pods[si][:2]} "
+                f"dst={pods[di][:2]}: kernel={got} oracle={want}"
+            )
+    stats = engine.tier_stats()
+    stats.update({
+        "pods": n_pods,
+        "policies": n_policies,
+        "build_s": round(build_s, 3),
+        "warmup_s": round(warmup_s, 3),
+        "eval_s": round(min(times), 4),
+        "parity_spot_checks": n_samples,
+    })
+    return stats
+
+
+def _tiers_leg(cases, n_pods: int, n_policies: int):
+    """Bounded wrapper for the tiers leg (BENCH_TIERS=0 skips; skipped
+    legs still record {active: False} so detail.tiers appears on every
+    line).  Oracle-parity failures re-raise loudly like the serve leg's."""
+    if os.environ.get("BENCH_TIERS", "1") != "1":
+        return {"active": False, "skipped": "BENCH_TIERS=0"}
+    from cyclonus_tpu.utils.bounded import run_bounded
+
+    _stall_env = float(os.environ.get("BENCH_STALL_S", "300"))
+    _bound = min(240.0, _stall_env * 0.8) if _stall_env > 0 else 600.0
+    status, value = run_bounded(
+        lambda: tiers_case(cases, n_pods, n_policies), _bound
+    )
+    if status == "ok":
+        return value
+    if status == "error" and isinstance(value, AssertionError):
+        raise value
+    return {
+        "active": False,
+        "status": status,
+        "error": None if status == "timeout" else repr(value),
+    }
+
+
 def mega_class_case(cases) -> dict:
     """The 1M-pod synthetic-cluster case (ROADMAP item 2): a cluster an
     order of magnitude past the headline shape, evaluable on one chip
@@ -1355,6 +1507,8 @@ def _bench(done):
         # recording the HEADLINE engine's state (detail.serve carries
         # the serve leg's own numbers)
         tel_snapshot = telemetry.snapshot()
+        _enter_phase("tiers")
+        tiers_detail = _tiers_leg(cases, n_pods, n_policies)
         _enter_phase("serve_churn")
         serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
         done.set()
@@ -1444,6 +1598,12 @@ def _bench(done):
                         # differential-parity assertions enforced
                         # (perfobs reads detail.serve on every line)
                         "serve": serve_detail,
+                        # the precedence-tier leg (BENCH_TIERS=0 skips,
+                        # still recording {active: False}): ANP/BANP
+                        # lattice resolve_s with oracle spot parity
+                        # (perfobs reads detail.tiers on every line,
+                        # warn-only like class_compression)
+                        "tiers": tiers_detail,
                         # the 1M-pod synthetic case (BENCH_MEGA): the
                         # compression-only shape, with its own
                         # class_compression block, HBM-budget check,
@@ -1504,6 +1664,8 @@ def _bench(done):
     # snapshot before the serve leg floods the flight-recorder ring
     # (same rationale as the tiled branch)
     tel_snapshot = telemetry.snapshot()
+    _enter_phase("tiers")
+    tiers_detail = _tiers_leg(cases, n_pods, n_policies)
     _enter_phase("serve_churn")
     serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
     done.set()
@@ -1531,6 +1693,7 @@ def _bench(done):
                     "parity_spot_checks": n_samples,
                     "class_compression": engine.class_compression_stats(),
                     "serve": serve_detail,
+                    "tiers": tiers_detail,
                     "telemetry": tel_snapshot,
                     "trace": _trace_detail(trace_dir),
                 },

@@ -23,6 +23,25 @@ Both claims are relative to the given cluster and port cases (exactly
 like the verdict grid itself), and every finding is cross-checked
 against the scalar matcher oracle on a sampled subset (analysis.oracle)
 before it is reported — a refuted claim raises instead of printing.
+
+Tier composition note (docs/DESIGN.md "Precedence tiers"): firing masks
+are a NetworkPolicy-TIER concept — a "rule" here is one peer matcher of
+one networkingv1 target, and the bool-OR identity above is the NP
+tier's internal semantics, NOT the cross-tier verdict (which is
+first-match-by-priority, engine/kernel.py resolve_tier_lattice).  The
+audit stays sound unchanged when AdminNetworkPolicy/BANP tiers are
+layered on top, because the lattice reads the NP tier ONLY through
+`has_target` and the per-cell any-allow OR: removing a never-firing or
+shadowed NP rule changes neither (a peer-row removal cannot flip
+has_target, and a shadowed rule's firing cells are covered in the OR),
+so the full lattice verdict is bit-identical too.  Consequently the
+oracle cross-check below runs the PLAIN networkingv1 oracle on purpose:
+it verifies the NP-tier claim directly, which is the stronger, tier-
+independent statement.  ANP/BANP rules themselves are NOT audited here
+— their semantics are first-match, where "shadowed" means something
+different (a lower-priority rule behind a total higher-priority match),
+a separate analysis.  engine.firing_components likewise excludes the
+tier slabs from its shared tensors (engine/api.py).
 """
 
 from __future__ import annotations

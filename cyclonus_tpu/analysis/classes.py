@@ -39,6 +39,7 @@ def audit_class_reduction(
     max_classes: int = 16,
     peers_per_class: int = 8,
     rng: Optional[random.Random] = None,
+    tiers=None,
 ) -> Dict:
     """Sampled oracle check that the class reduction is sound.
 
@@ -52,7 +53,29 @@ def audit_class_reduction(
     Returns {"checked_classes", "checked_cells", "violations", "ok"};
     each violation records (class id, pod a, pod b, peer, case index,
     orientation, verdict a, verdict b).
+
+    `tiers` (an optional tiers.model.TierSet) switches the reference to
+    the tiered lattice oracle (matcher/tiered.py): when the audited
+    engine carries AdminNetworkPolicy/BANP tiers, co-classed pods must
+    be indistinguishable to the FULL lattice, not just the NP tier —
+    tier subject/peer selectors live in the same shared selector table
+    the class signature packs, so the claim holds by construction, and
+    this audit is the oracle-side proof (the pre-tier plain-oracle
+    check would silently under-assert on a tiered engine: a latent
+    verdict==bool-OR assumption the lattice exposed).
     """
+    if tiers:
+        from ..matcher.tiered import TieredPolicy
+
+        # compiled ONCE: the lattice oracle re-validates the TierSet and
+        # recompiles every rule's port matchers at construction, and
+        # this audit calls it per sampled cell
+        _tiered = TieredPolicy(policy, tiers)
+
+        def verdicts(pol, t):
+            return _tiered.is_traffic_allowed(t)
+    else:
+        verdicts = oracle_verdicts
     rng = rng or random.Random(0)
     n = len(pods)
     if n != classes.n_pods:
@@ -79,10 +102,10 @@ def audit_class_reduction(
         for qi, case in enumerate(cases):
             for p in peers:
                 # as source: a -> p must equal b -> p
-                va = oracle_verdicts(
+                va = verdicts(
                     policy, traffic_for_cell(pods, namespaces, case, a, p)
                 )
-                vb = oracle_verdicts(
+                vb = verdicts(
                     policy, traffic_for_cell(pods, namespaces, case, b, p)
                 )
                 checked_cells += 2
@@ -95,10 +118,10 @@ def audit_class_reduction(
                         }
                     )
                 # as destination: p -> a must equal p -> b
-                va = oracle_verdicts(
+                va = verdicts(
                     policy, traffic_for_cell(pods, namespaces, case, p, a)
                 )
-                vb = oracle_verdicts(
+                vb = verdicts(
                     policy, traffic_for_cell(pods, namespaces, case, p, b)
                 )
                 checked_cells += 2

@@ -28,6 +28,14 @@ def setup_serve(sub) -> None:
         "(default: start with no policies)",
     )
     cmd.add_argument(
+        "--anps",
+        default="",
+        metavar="PATH",
+        help="YAML file/dir of AdminNetworkPolicy / "
+        "BaselineAdminNetworkPolicy objects layered over --policies "
+        "(docs/DESIGN.md \"Precedence tiers\")",
+    )
+    cmd.add_argument(
         "--synthesize-pods",
         action="store_true",
         help="synthesize an initial pod set exercising every policy-"
@@ -114,6 +122,11 @@ def run_serve(args) -> int:
     policies = (
         load_policies_from_path(args.policies) if args.policies else []
     )
+    tiers = None
+    if args.anps:
+        from ..tiers.model import load_tier_set_from_path
+
+        tiers = load_tier_set_from_path(args.anps) or None
     pods, namespaces = [], {}
     if args.synthetic_pods:
         pods, namespaces = synthetic_cluster(
@@ -133,6 +146,7 @@ def run_serve(args) -> int:
         policies,
         simplify=not args.no_simplify,
         class_compress=args.class_compress or None,
+        tiers=tiers,
     )
     if args.metrics_port is not None:
         try:
@@ -147,9 +161,16 @@ def run_serve(args) -> int:
             file=sys.stderr,
         )
     st = service.state()
+    tier_note = ""
+    if st["tiers"]["active"]:
+        tier_note = (
+            f", {st['tiers']['anp_count']} ANPs"
+            f"{' + BANP' if st['tiers']['banp'] else ''}"
+        )
     print(
         f"serve: engine ready — {st['pods']} pods, {st['policies']} "
-        f"policies (epoch {st['epoch']}); reading batches from stdin",
+        f"policies{tier_note} (epoch {st['epoch']}); reading batches "
+        f"from stdin",
         file=sys.stderr,
     )
     run_stdio(service, sys.stdin, sys.stdout, max_lines=args.max_lines)

@@ -154,6 +154,24 @@ def _direction_tensors(enc: _DirectionEncoding) -> Dict:
     return d
 
 
+def _tier_tensors(tenc) -> Dict:
+    """Tensor-dict view of one direction's TierDirectionEncoding
+    (encoding.py): the int8 verdict + int32 rank slabs, the shared-table
+    selector ids, and the per-row port spec."""
+    return {
+        "subj_ns_sel": tenc.subj_ns_sel,
+        "subj_pod_kind": tenc.subj_pod_kind,
+        "subj_pod_sel": tenc.subj_pod_sel,
+        "peer_ns_sel": tenc.peer_ns_sel,
+        "peer_pod_kind": tenc.peer_pod_kind,
+        "peer_pod_sel": tenc.peer_pod_sel,
+        "action": tenc.action,  # shape: (G,) int8; sentinel: 0=pad
+        "tier": tenc.tier,
+        "rank": tenc.rank,
+        "port_spec": dict(tenc.port_spec),
+    }
+
+
 def _selector_match_np(
     sel_req_kv: np.ndarray,  # [S, R]
     sel_exp_op: np.ndarray,  # [S, E]
@@ -415,6 +433,20 @@ _PORT_SPEC_PADS = {
     "rng_proto": -2,
     "spec_all": False,
 }
+# tier-slab pads: action 0 = TIER_ACT_NONE — a padded rule row matches
+# nothing (every kernel masks on action > 0), so selector/rank fills
+# are inert by construction
+_TIER_PADS = {
+    "subj_ns_sel": 0,
+    "subj_pod_kind": 0,
+    "subj_pod_sel": -1,
+    "peer_ns_sel": 0,
+    "peer_pod_kind": 0,
+    "peer_pod_sel": -1,
+    "action": 0,
+    "tier": 0,
+    "rank": 0,
+}
 
 
 def _bucket_tensors(tensors: Dict) -> Dict:
@@ -481,6 +513,24 @@ def _bucket_tensors(tensors: Dict) -> Dict:
             spec[k] = a
         d["port_spec"] = spec
         t[direction] = d
+    # precedence-tier slabs: the rule axis buckets like the peer axis,
+    # padded with inert (action 0) rows
+    if "tiers" in t:
+        tiers = {}
+        for direction in ("ingress", "egress"):
+            d = dict(t["tiers"][direction])
+            g = _bucket_dim(d["action"].shape[0])
+            for k, fill in _TIER_PADS.items():
+                d[k] = _pad_axis(d[k], 0, g, fill)
+            spec = {}
+            for k, fill in _PORT_SPEC_PADS.items():
+                a = _pad_axis(d["port_spec"][k], 0, g, fill)
+                if a.ndim == 2:
+                    a = _pad_axis(a, 1, _bucket_dim(a.shape[1]), fill)
+                spec[k] = a
+            d["port_spec"] = spec
+            tiers[direction] = d
+        t["tiers"] = tiers
     # pod axis last: the inert-row scheme lives in _pad_pod_arrays
     n = t["pod_ns_id"].shape[0]
     t, _ = _pad_pod_arrays(t, n, _bucket_pods(n))
@@ -609,7 +659,12 @@ def _pack_tensors(tree):
     off = 0
     for leaf in leaves:
         a = np.ascontiguousarray(leaf)
-        if a.dtype not in (np.dtype(np.int32), np.dtype(np.uint32), np.dtype(bool)):
+        if a.dtype not in (
+            np.dtype(np.int32),
+            np.dtype(np.uint32),
+            np.dtype(bool),
+            np.dtype(np.int8),
+        ):
             # unpack below BITCASTS from int32 words; any other dtype
             # would be silently reinterpreted — fail loudly instead
             raise TypeError(f"_pack_tensors: unsupported leaf dtype {a.dtype}")
@@ -638,6 +693,10 @@ def _pack_tensors(tree):
             if dtype == np.bool_:
                 flat = jax.lax.bitcast_convert_type(words, jnp.uint8)
                 arr = flat.reshape(-1)[:n].astype(jnp.bool_)
+            elif dtype == np.int8:
+                # the tier action slab: 4 int8 lanes per packed word
+                flat = jax.lax.bitcast_convert_type(words, jnp.int8)
+                arr = flat.reshape(-1)[:n]
             elif dtype == np.uint32:
                 arr = jax.lax.bitcast_convert_type(words, jnp.uint32)
             else:  # int32 (the only other dtype _pack_tensors accepts)
@@ -681,6 +740,7 @@ class TpuPolicyEngine:
         *,
         compact: Optional[bool] = None,
         class_compress: Optional[str] = None,
+        tiers=None,
     ):
         # compact/class_compress override the CYCLONUS_COMPACT /
         # CYCLONUS_CLASS_COMPRESS env defaults per engine (None = env).
@@ -688,6 +748,11 @@ class TpuPolicyEngine:
         # target compaction bakes "no pod matches this target" into the
         # tensors, and a pod delta can make a dead target live, so a
         # delta-oriented engine must keep every target resident.
+        # tiers: an optional tiers.model.TierSet — AdminNetworkPolicy/
+        # BANP precedence tiers layered over the NetworkPolicy verdict
+        # (docs/DESIGN.md "Precedence tiers").  With it absent or empty,
+        # the tensor set — and therefore every compiled program — is
+        # byte-identical to the networkingv1-only engine.
         # every evaluation path below is jax-backed: first-touch setup of
         # the persistent compile cache happens here, not at import time
         from . import ensure_persistent_compile_cache
@@ -695,8 +760,13 @@ class TpuPolicyEngine:
         ensure_persistent_compile_cache()
         self._opt_compact = compact
         self._opt_class_compress = class_compress
+        self.tiers = tiers if tiers else None
+        if self.tiers is not None:
+            self.tiers.validate()
         with phase("engine.encode"):
-            self.encoding: PolicyEncoding = encode_policy(policy, pods, namespaces)
+            self.encoding: PolicyEncoding = encode_policy(
+                policy, pods, namespaces, tiers=self.tiers
+            )
             self._tensors = self._build_tensors()
             # one O(S*N) host selector pass serves both consumers: dead-
             # target compaction here and the slab-window plan later
@@ -756,6 +826,9 @@ class TpuPolicyEngine:
                     + sum(a.nbytes for a in _np_leaves(st["ctensors"]))
                 )
                 ti.CLASS_AUX_BYTES.set(st["aux_bytes"])
+        # wall-clock of the last tiered grid evaluation's dispatch
+        # (detail.tiers.resolve_s; None until a tiered eval ran)
+        self._tier_resolve_s = None
         self._device_tensors = None  # lazily device_put once
         self._packed_buf = None  # single-buffer device copy (all paths)
         self._unpack = None
@@ -876,6 +949,11 @@ class TpuPolicyEngine:
             "ingress": _direction_tensors(enc.ingress),
             "egress": _direction_tensors(enc.egress),
         }
+        if enc.tiers is not None:
+            tensors["tiers"] = {
+                "ingress": _tier_tensors(enc.tiers[0]),
+                "egress": _tier_tensors(enc.tiers[1]),
+            }
         for direction, denc in (("ingress", enc.ingress), ("egress", enc.egress)):
             if denc.host_ip_rows:
                 # IPv6 / mixed-family IPBlocks: evaluate via the oracle's IP
@@ -970,6 +1048,29 @@ class TpuPolicyEngine:
             "signature_bytes": pc.signature_bytes,
             "aux_bytes": st["aux_bytes"],
             "partitions": self._partition_stats,
+        }
+
+    def tier_stats(self) -> Dict:
+        """The precedence-tier summary bench.py records as detail.tiers
+        on every line: whether the lattice is active, the ANP object /
+        flat rule-row counts, and the wall-clock of the last tiered grid
+        evaluation (resolve_s; None until one ran)."""
+        if self.tiers is None:
+            return {
+                "active": False,
+                "anp_count": 0,
+                "rule_rows": 0,
+                "banp": False,
+                "resolve_s": None,
+            }
+        enc_t = self.encoding.tiers
+        rows = sum(t.n_rows for t in enc_t) if enc_t is not None else 0
+        return {
+            "active": True,
+            "anp_count": len(self.tiers.anps),
+            "rule_rows": rows,
+            "banp": self.tiers.banp is not None,
+            "resolve_s": self._tier_resolve_s,
         }
 
     def _ctensors_with_cases(
@@ -1089,8 +1190,11 @@ class TpuPolicyEngine:
                         evaluate_grid_kernel(t), co
                     )
                 )
+            t0 = time.perf_counter()
             with phase("engine.dispatch"):
                 out = self._class_grid_jit(tensors, self._class_of_dev)
+            if self.tiers is not None:
+                self._tier_resolve_s = time.perf_counter() - t0
             ti.CLASS_EVALS.inc(path="grid")
         return GridVerdict(
             self.pod_keys,
@@ -1202,8 +1306,11 @@ class TpuPolicyEngine:
             tensors = self._tensors_with_cases(cases, device=True)
             # dispatch-only timing: jit calls return once enqueued (async);
             # device execution time lands in grid.fetch / allow_stats
+            t0 = time.perf_counter()
             with phase("engine.dispatch"):
                 out = evaluate_grid_kernel(tensors)
+            if self.tiers is not None:
+                self._tier_resolve_s = time.perf_counter() - t0
         # kernel emits [q, ...] layout directly: one device execution
         # total.  Bucketing pads the pod axis; the lazy device slice
         # strips the pad rows so GridVerdict stays exactly n x n.
@@ -1269,6 +1376,7 @@ class TpuPolicyEngine:
         (engine/tiled.py) — elsewhere, where pallas would fall back to
         slow interpret mode.  Identical results by construction; pass
         backend explicitly to force either."""
+        explicit = backend is not None
         if backend is None:
             import jax
 
@@ -1278,6 +1386,22 @@ class TpuPolicyEngine:
                 f"unknown counts backend {backend!r} (want 'xla' or "
                 f"'pallas'; mesh-parallel = evaluate_grid_counts_sharded)"
             )
+        if self.tiers is not None and backend == "pallas":
+            # the fused pallas counts kernel keeps the networkingv1-only
+            # fast path (its OR-reduction precompute cannot express the
+            # first-match lattice); tiered counts run the XLA tile loop,
+            # whose shared tile body carries the resolution epilogue.
+            # The auto default routes silently; an EXPLICIT pallas
+            # request fails loudly like the unknown-backend branch —
+            # silently rewriting it would let a benchmark publish the
+            # XLA rate under the pallas label
+            if explicit:
+                raise ValueError(
+                    "counts backend 'pallas' cannot evaluate the "
+                    "precedence-tier lattice; use backend='xla' or "
+                    "backend=None (auto) on a tiered engine"
+                )
+            backend = "xla"
         self._check_ips()
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
@@ -1998,6 +2122,18 @@ class TpuPolicyEngine:
             )
         from .tiled import evaluate_grid_counts_sharded
 
+        if self.tiers is not None and kernel != "xla":
+            # per-device pallas keeps the networkingv1 fast path; the
+            # XLA tile body carries the tier resolution epilogue.  Same
+            # rule as evaluate_grid_counts: auto routes, an explicit
+            # pallas request fails loudly
+            if kernel is not None:
+                raise ValueError(
+                    f"sharded counts kernel {kernel!r} cannot evaluate "
+                    "the precedence-tier lattice; use kernel='xla' or "
+                    "kernel=None (auto) on a tiered engine"
+                )
+            kernel = "xla"
         return evaluate_grid_counts_sharded(
             self._tensors_with_cases(cases), n, block=block, mesh=mesh,
             kernel=kernel,
@@ -2096,8 +2232,16 @@ class TpuPolicyEngine:
         self._check_ips()
         raw = self._build_tensors()
         q_port, q_name, q_proto = self._port_case_arrays(cases)
+        # "tiers" excluded on purpose: firing masks are a NetworkPolicy-
+        # TIER concept (rule = one peer matcher of one target).  The
+        # audit built on them stays sound under the lattice — see
+        # analysis/audit.py's tier-composition note — because removing a
+        # shadowed NP rule changes neither has_target nor any any_allow
+        # cell, and the lattice reads the NP tier only through those two.
         shared = {
-            k: v for k, v in raw.items() if k not in ("ingress", "egress")
+            k: v
+            for k, v in raw.items()
+            if k not in ("ingress", "egress", "tiers")
         }
         shared["q_port"] = q_port
         shared["q_name"] = q_name

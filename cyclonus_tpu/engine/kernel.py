@@ -24,7 +24,7 @@ integers, never rounded to zero).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +47,12 @@ from .encoding import (
     PORT_INT,
     PORT_NAMED,
     PORT_NIL,
+    TIER_ACT_ALLOW,
+    TIER_ACT_NONE,
+    TIER_ACT_PASS,
+    TIER_ANP,
+    TIER_BANP,
+    TIER_KEY_NONE,
 )
 
 
@@ -253,6 +259,159 @@ def direction_allowed(
     return allowed.reshape(-1, n_np, q)
 
 
+# --- precedence-tier resolution epilogue ----------------------------------
+#
+# The ANP/BANP lattice (docs/DESIGN.md "Precedence tiers") replaces the
+# bool-OR assumption with FIRST-MATCH-BY-PRIORITY: tier rows carry an
+# int8 action and an int32 rank (encoding.TierDirectionEncoding), and the
+# first matching rule of a tier is the min over matching rows of the
+# combined key rank * 4 + action (actions are 1..3, so key % 4 recovers
+# the winning action and min-of-keys == first-match because ranks are the
+# resolution order).  Rows of one rule share its rank, which makes the
+# within-rule peer OR exact under the min.  TIER_KEY_NONE (2^30) is the
+# no-match identity.  All of it composes with the class-compressed grid
+# unchanged: tier rules observe pods only through (ns id, shared-table
+# selector matches), both part of the class signature.
+
+
+def tier_scope_match(
+    ns_sel: jnp.ndarray,  # [G] selector ids (namespace labels)
+    pod_kind: jnp.ndarray,  # [G] POD_ALL | POD_SELECTOR
+    pod_sel: jnp.ndarray,  # [G] selector ids (pod labels; -1 when ALL)
+    selpod: jnp.ndarray,  # [S, N]
+    selns: jnp.ndarray,  # [S, M]
+    pod_ns_id: jnp.ndarray,  # [N]
+) -> jnp.ndarray:
+    """[G, N] bool: tier scope g (a subject or peer) matches pod n —
+    namespace labels via selns, pod labels via selpod (the shared
+    selector table; mirrors tiers.model.scope_matches)."""
+    ns_by_pod = jnp.take(
+        jnp.take(selns, ns_sel, axis=0), pod_ns_id, axis=1
+    )  # [G, N]
+    pod_m = jnp.take(selpod, jnp.maximum(pod_sel, 0), axis=0)  # [G, N]
+    pod_ok = jnp.where(pod_kind[:, None] == POD_SELECTOR, pod_m, True)
+    return ns_by_pod & pod_ok
+
+
+def tier_keys(tenc: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(anp_key [G], banp_key [G]) int32 priority keys: rank * 4 + action
+    for real rows of each tier, TIER_KEY_NONE elsewhere (pad rows carry
+    action 0 and are inert in both)."""
+    act = tenc["action"].astype(jnp.int32)  # int8 verdict slab -> key arith
+    key = tenc["rank"] * 4 + act
+    tier = tenc["tier"].astype(jnp.int32)
+    valid = act > TIER_ACT_NONE
+    none = jnp.int32(TIER_KEY_NONE)
+    anp = jnp.where(valid & (tier == TIER_ANP), key, none)
+    banp = jnp.where(valid & (tier == TIER_BANP), key, none)
+    return anp, banp
+
+
+def tier_first_match_keys(
+    subj: jnp.ndarray,  # [G, A] bool — subject side (target pods)
+    peerq: jnp.ndarray,  # [G, B, Q] bool — peer side x port cases
+    anp_key: jnp.ndarray,  # [G] int32
+    banp_key: jnp.ndarray,  # [G] int32
+    chunk: int = 8,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """([A, B, Q], [A, B, Q]) int32 min matching keys per tier.
+
+    Scans the rule axis in `chunk`-row slices so the [c, A, B, Q] match
+    intermediate — not [G, A, B, Q] — is the only rule-axis blowup; G is
+    shape-bucketed to a power of two (api._bucket_tensors), so the
+    clamped chunk always divides it."""
+    g = subj.shape[0]
+    a = subj.shape[1]
+    b, q = peerq.shape[1], peerq.shape[2]
+    c = min(chunk, g)
+    none = jnp.int32(TIER_KEY_NONE)
+    init = (
+        jnp.full((a, b, q), none, dtype=jnp.int32),
+        jnp.full((a, b, q), none, dtype=jnp.int32),
+    )
+
+    def body(carry, xs):
+        s, pq, ka, kb = xs  # [c, A], [c, B, Q], [c], [c]
+        m = s[:, :, None, None] & pq[:, None, :, :]  # [c, A, B, Q]
+        a_min = jnp.min(jnp.where(m, ka[:, None, None, None], none), axis=0)
+        b_min = jnp.min(jnp.where(m, kb[:, None, None, None], none), axis=0)
+        return (
+            jnp.minimum(carry[0], a_min),
+            jnp.minimum(carry[1], b_min),
+        ), None
+
+    (anp_min, banp_min), _ = jax.lax.scan(
+        body,
+        init,
+        (
+            subj.reshape(g // c, c, a),
+            peerq.reshape(g // c, c, b, q),
+            anp_key.reshape(g // c, c),
+            banp_key.reshape(g // c, c),
+        ),
+    )
+    return anp_min, banp_min
+
+
+def resolve_tier_lattice(
+    np_allowed: jnp.ndarray,  # NetworkPolicy-tier verdict (any shape)
+    has_target_b: jnp.ndarray,  # bool, broadcastable to np_allowed
+    anp_min: jnp.ndarray,  # int32 min ANP key, same shape as np_allowed
+    banp_min: jnp.ndarray,
+) -> jnp.ndarray:
+    """The lattice fold: ANP first-match (Allow/Deny final, Pass falls
+    through), then the NetworkPolicy tier WHERE a target selects the pod
+    (final), then BANP first-match, then default-allow.  np_allowed is
+    the existing direction verdict (~has_target | any_allow): where
+    has_target holds it equals the NP-tier verdict, and elsewhere it is
+    bypassed, so the epilogue composes with every evaluator's existing
+    output unchanged."""
+    anp_act = jnp.where(anp_min < TIER_KEY_NONE, anp_min % 4, TIER_ACT_NONE)
+    banp_act = jnp.where(banp_min < TIER_KEY_NONE, banp_min % 4, TIER_ACT_NONE)
+    below = jnp.where(
+        has_target_b,
+        np_allowed,
+        jnp.where(
+            banp_act == TIER_ACT_NONE, True, banp_act == TIER_ACT_ALLOW
+        ),
+    )
+    return jnp.where(
+        (anp_act == TIER_ACT_NONE) | (anp_act == TIER_ACT_PASS),
+        below,
+        anp_act == TIER_ACT_ALLOW,
+    )
+
+
+def tier_direction_arrays(
+    tenc: Dict[str, jnp.ndarray],
+    selpod: jnp.ndarray,
+    selns: jnp.ndarray,
+    pod_ns_id: jnp.ndarray,
+    q_port: jnp.ndarray,
+    q_name: jnp.ndarray,
+    q_proto: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Per-direction tier precompute over ONE pod set (grid kernels use
+    the same set for both sides): subj [G, N], peerq [G, N, Q], and the
+    two [G] key vectors."""
+    subj = tier_scope_match(
+        tenc["subj_ns_sel"], tenc["subj_pod_kind"], tenc["subj_pod_sel"],
+        selpod, selns, pod_ns_id,
+    )
+    peer = tier_scope_match(
+        tenc["peer_ns_sel"], tenc["peer_pod_kind"], tenc["peer_pod_sel"],
+        selpod, selns, pod_ns_id,
+    )
+    pport = port_spec_allows(tenc["port_spec"], q_port, q_name, q_proto)
+    anp_key, banp_key = tier_keys(tenc)
+    return {
+        "subj": subj,
+        "peerq": peer[:, :, None] & pport[:, None, :],
+        "anp_key": anp_key,
+        "banp_key": banp_key,
+    }
+
+
 @partial(jax.jit, static_argnames=())
 def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
     """Full-grid verdict on one device.
@@ -306,6 +465,27 @@ def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
         out[direction] = direction_allowed(
             pre["tmatch"], pre["has_target"], m_tp_onehot(enc), peer_match, pport
         )
+        if "tiers" in tensors:
+            # precedence-tier resolution epilogue: same trace, one
+            # device execution still (docs/DESIGN.md "Precedence tiers")
+            ta = tier_direction_arrays(
+                tensors["tiers"][direction],
+                selpod,
+                selns,
+                tensors["pod_ns_id"],
+                tensors["q_port"],
+                tensors["q_name"],
+                tensors["q_proto"],
+            )
+            anp_min, banp_min = tier_first_match_keys(
+                ta["subj"], ta["peerq"], ta["anp_key"], ta["banp_key"]
+            )
+            out[direction] = resolve_tier_lattice(
+                out[direction],
+                pre["has_target"][:, None, None],
+                anp_min,
+                banp_min,
+            )
 
     # ingress is indexed [dst, src, q]; egress [src, dst, q]
     combined = out["egress"] & jnp.swapaxes(out["ingress"], 0, 1)

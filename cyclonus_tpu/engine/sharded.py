@@ -53,7 +53,10 @@ from .kernel import (
     direction_precompute,
     m_tp_onehot,
     port_spec_allows,
+    resolve_tier_lattice,
     selector_match,
+    tier_direction_arrays,
+    tier_first_match_keys,
 )
 
 # pod-axis-sharded tensor keys
@@ -72,6 +75,9 @@ def pod_sharded_in_specs(tensors: Dict) -> Dict:
             in_specs[k] = (
                 P("x") if np.ndim(v) == 1 else P("x", *([None] * (np.ndim(v) - 1)))
             )
+        elif k == "tiers":
+            # tier slabs are rule-axis arrays: replicated, leaf by leaf
+            in_specs[k] = jax.tree_util.tree_map(lambda _: P(), v)
         elif k in ("ingress", "egress"):
             sub = {}
             for kk, vv in v.items():
@@ -208,6 +214,24 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
 
     q = tensors["q_port"].shape[0]
 
+    # precedence-tier precompute over the LOCAL pod block; the remote
+    # side of each direction is all-gathered below exactly like the
+    # NetworkPolicy arrays (docs/DESIGN.md "Precedence tiers")
+    tier = None
+    if "tiers" in tensors:
+        tier = {
+            d: tier_direction_arrays(
+                tensors["tiers"][d],
+                selpod,
+                selns,
+                tensors["pod_ns_id"],
+                tensors["q_port"],
+                tensors["q_name"],
+                tensors["q_proto"],
+            )
+            for d in ("ingress", "egress")
+        }
+
     # --- egress: local source block is the target side ---
     enc_e, pre_e = tensors["egress"], pre["egress"]
     n_b = pre_e["peer_match"].shape[1]
@@ -225,6 +249,18 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
         pre_e["tmatch"].T, g_tallow_e.reshape(t_e, n_total * q)
     ).reshape(n_b, n_total, q)
     egress = (~pre_e["has_target"][:, None, None]) | any_allow_e  # [Sb, N, Q]
+    if tier is not None:
+        te = tier["egress"]
+        # subject = local source block; peer side gathers like tallow
+        g_peerq_e = jax.lax.all_gather(
+            te["peerq"], "x", axis=1, tiled=True
+        )  # [G, N, Q]
+        anp_e, banp_e = tier_first_match_keys(
+            te["subj"], g_peerq_e, te["anp_key"], te["banp_key"]
+        )  # [Sb, N, Q]
+        egress = resolve_tier_lattice(
+            egress, pre_e["has_target"][:, None, None], anp_e, banp_e
+        )
 
     # --- ingress: local source block is the peer side ---
     enc_i, pre_i = tensors["ingress"], pre["ingress"]
@@ -242,6 +278,18 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
     ingress_t = (
         (~g_has_t_i[:, None, None]) | any_allow_i.reshape(n_total, n_b, q)
     )  # [N_dst, Sb, Q]
+    if tier is not None:
+        ti_ = tier["ingress"]
+        # target side gathers (like tmatch); peer = local source block
+        g_subj_i = jax.lax.all_gather(
+            ti_["subj"], "x", axis=1, tiled=True
+        )  # [G, N]
+        anp_i, banp_i = tier_first_match_keys(
+            g_subj_i, ti_["peerq"], ti_["anp_key"], ti_["banp_key"]
+        )  # [N_dst, Sb, Q]
+        ingress_t = resolve_tier_lattice(
+            ingress_t, g_has_t_i[:, None, None], anp_i, banp_i
+        )
     ingress_rows = jnp.swapaxes(ingress_t, 0, 1)  # [Sb, N_dst, Q]
 
     combined = egress & ingress_rows

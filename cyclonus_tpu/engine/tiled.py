@@ -51,11 +51,15 @@ import numpy as np
 
 from ..telemetry import instruments as ti
 from ..utils.tracing import phase
+from .encoding import TIER_KEY_NONE
 from .kernel import (
     direction_precompute,
     m_tp_onehot,
     port_spec_allows,
+    resolve_tier_lattice,
     selector_match,
+    tier_direction_arrays,
+    tier_first_match_keys,
 )
 
 
@@ -126,7 +130,35 @@ def _precompute(tensors: Dict) -> Dict[str, Dict[str, jnp.ndarray]]:
             "tmatch": pre["tmatch"],
             "has_target": pre["has_target"],
         }
+        if "tiers" in tensors:
+            # precedence-tier precompute (docs/DESIGN.md "Precedence
+            # tiers"): subj/peerq/keys ride next to tallow so every tile
+            # body can run the first-match resolution epilogue
+            out[direction]["tier"] = tier_direction_arrays(
+                tensors["tiers"][direction],
+                selpod,
+                selns,
+                tensors["pod_ns_id"],
+                tensors["q_port"],
+                tensors["q_name"],
+                tensors["q_proto"],
+            )
     return out
+
+
+#: the dst-side bundle keys — the arrays the ring paths rotate with
+#: ppermute.  Tier arrays indexed by the DST pod axis (egress peer side,
+#: ingress target side) must ride the bundle or a rotated step would
+#: resolve tiers against the wrong shard.
+_DST_VIEW_KEYS = ("tallow_e", "tmatch_i", "has_i")
+_DST_TIER_KEYS = ("tier_peerq_e", "tier_subj_i")
+
+
+def _dst_bundle_keys(ring: Dict) -> Tuple[str, ...]:
+    keys = _DST_VIEW_KEYS
+    if "tier_peerq_e" in ring:
+        keys = keys + _DST_TIER_KEYS
+    return keys
 
 
 def _split_pre(pre: Dict) -> Tuple[Dict, Dict]:
@@ -134,7 +166,9 @@ def _split_pre(pre: Dict) -> Tuple[Dict, Dict]:
     tile's source rows: egress target side + ingress peer side) and the
     DST-side view (egress peer side + ingress target side).  On a single
     device both views slice the same arrays; in the ring path the dst
-    view is the rotating remote shard."""
+    view is the rotating remote shard.  Tier arrays split the same way:
+    subjects sit on the direction's target side, peerq on its peer side;
+    the [G] key vectors are pod-independent and stay in the src view."""
     src = {
         "tmatch_e": pre["egress"]["tmatch"],
         "has_e": pre["egress"]["has_target"],
@@ -145,6 +179,14 @@ def _split_pre(pre: Dict) -> Tuple[Dict, Dict]:
         "tmatch_i": pre["ingress"]["tmatch"],
         "has_i": pre["ingress"]["has_target"],
     }
+    if "tier" in pre["egress"]:
+        te, ti_ = pre["egress"]["tier"], pre["ingress"]["tier"]
+        src["tier_subj_e"] = te["subj"]
+        src["tier_peerq_i"] = ti_["peerq"]
+        src["tier_keys_e"] = jnp.stack([te["anp_key"], te["banp_key"]])
+        src["tier_keys_i"] = jnp.stack([ti_["anp_key"], ti_["banp_key"]])
+        dst["tier_peerq_e"] = te["peerq"]
+        dst["tier_subj_i"] = ti_["subj"]
     return src, dst
 
 
@@ -186,8 +228,36 @@ def _tile_verdicts_split(
         > 0
     ).reshape(nd, block, q)
     ingress_t = (~dst["has_i"][:, None, None]) | any_i  # [Nd, B, Q]
-    ingress_rows = jnp.swapaxes(ingress_t, 0, 1)  # [B, Nd, Q]
 
+    if "tier_subj_e" in src:
+        # precedence-tier resolution epilogue, per tile (docs/DESIGN.md
+        # "Precedence tiers"): egress subjects are the source block,
+        # ingress subjects the dst view — same first-match fold as the
+        # full-grid kernel, over this tile's slices
+        g_e = src["tier_subj_e"].shape[0]
+        subj_e = jax.lax.dynamic_slice(
+            src["tier_subj_e"], (0, start), (g_e, block)
+        )  # [G, B]
+        anp_e, banp_e = tier_first_match_keys(
+            subj_e, dst["tier_peerq_e"], src["tier_keys_e"][0],
+            src["tier_keys_e"][1],
+        )  # [B, Nd, Q]
+        egress = resolve_tier_lattice(
+            egress, hte[:, None, None], anp_e, banp_e
+        )
+        g_i = src["tier_peerq_i"].shape[0]
+        peerq_i = jax.lax.dynamic_slice(
+            src["tier_peerq_i"], (0, start, 0), (g_i, block, q)
+        )  # [G, B, Q]
+        anp_i, banp_i = tier_first_match_keys(
+            dst["tier_subj_i"], peerq_i, src["tier_keys_i"][0],
+            src["tier_keys_i"][1],
+        )  # [Nd, B, Q]
+        ingress_t = resolve_tier_lattice(
+            ingress_t, dst["has_i"][:, None, None], anp_i, banp_i
+        )
+
+    ingress_rows = jnp.swapaxes(ingress_t, 0, 1)  # [B, Nd, Q]
     combined = egress & ingress_rows
     return ingress_rows, egress, combined
 
@@ -624,7 +694,7 @@ def evaluate_grid_counts_ring(
 
         def ring_step(step, carry):
             counts, ring = carry
-            dst = {k: ring[k] for k in ("tallow_e", "tmatch_i", "has_i")}
+            dst = {k: ring[k] for k in _dst_bundle_keys(ring)}
 
             def tile(i, counts):
                 row = _tile_counts_split(
@@ -721,7 +791,7 @@ def evaluate_grid_counts_ring2d(
             # only the n_ici-step round body is traced; rounds ride the
             # fori_loop so program size is independent of the host count
             for j in range(n_ici):
-                dst = {k: ring[k] for k in ("tallow_e", "tmatch_i", "has_i")}
+                dst = {k: ring[k] for k in _dst_bundle_keys(ring)}
 
                 def tile(i, counts, _dst=dst, _rv=ring["valid"], _j=j):
                     row = _tile_counts_split(
@@ -942,7 +1012,41 @@ def evaluate_pairs_kernel(
             pre_t["tmatch"].astype(jnp.bfloat16),
             tallow.astype(jnp.bfloat16),
         ) > 0
-        return (~pre_t["has_target"][:, None]) | any_allow
+        allowed = (~pre_t["has_target"][:, None]) | any_allow
+        if "tiers" in tensors:
+            # precedence-tier epilogue for point pairs: subject over the
+            # target-side pods, peer over the peer-side pods, aligned
+            # per pair k — [G, K] masks, no grid anywhere
+            from .kernel import tier_keys, tier_scope_match
+
+            tenc = tensors["tiers"][direction]
+            subj = tier_scope_match(
+                tenc["subj_ns_sel"], tenc["subj_pod_kind"],
+                tenc["subj_pod_sel"], sel_t, selns, t_sub["pod_ns_id"],
+            )  # [G, K]
+            peer = tier_scope_match(
+                tenc["peer_ns_sel"], tenc["peer_pod_kind"],
+                tenc["peer_pod_sel"], sel_p, selns, p_sub["pod_ns_id"],
+            )  # [G, K]
+            pport_t = port_spec_allows(
+                tenc["port_spec"],
+                tensors["q_port"],
+                tensors["q_name"],
+                tensors["q_proto"],
+            )  # [G, Q]
+            match = (subj & peer)[:, :, None] & pport_t[:, None, :]  # [G,K,Q]
+            anp_key, banp_key = tier_keys(tenc)
+            none = jnp.int32(TIER_KEY_NONE)
+            anp_min = jnp.min(
+                jnp.where(match, anp_key[:, None, None], none), axis=0
+            )
+            banp_min = jnp.min(
+                jnp.where(match, banp_key[:, None, None], none), axis=0
+            )
+            allowed = resolve_tier_lattice(
+                allowed, pre_t["has_target"][:, None], anp_min, banp_min
+            )
+        return allowed
 
     egress = direction_pair("egress", s_idx, d_idx)  # src is target side
     ingress = direction_pair("ingress", d_idx, s_idx)  # dst is target side

@@ -61,6 +61,15 @@ TAG_CONFLICT = "conflict"
 TAG_EXAMPLE = "example"
 TAG_UPSTREAM_E2E = "upstream-e2e"
 
+# precedence-tier subordinates (the ANP/BANP conformance family,
+# generator/anp_cases.py) — filed under the previously-empty
+# policy-stack primary: tier cases are exactly about how stacked
+# policy layers compose
+TAG_ANP = "admin-network-policy"
+TAG_BANP = "baseline-admin-network-policy"
+TAG_TIER_PASS = "tier-pass"
+TAG_DEFAULT_DENY_NS = "per-namespace-default-deny"
+
 ALL_TAGS: Dict[str, List[str]] = {
     TAG_ACTION: [
         TAG_CREATE_POLICY,
@@ -75,7 +84,12 @@ ALL_TAGS: Dict[str, List[str]] = {
     ],
     TAG_TARGET: [TAG_TARGET_NAMESPACE, TAG_TARGET_POD_SELECTOR],
     TAG_DIRECTION: [TAG_INGRESS, TAG_EGRESS],
-    TAG_POLICY_STACK: [],
+    TAG_POLICY_STACK: [
+        TAG_ANP,
+        TAG_BANP,
+        TAG_TIER_PASS,
+        TAG_DEFAULT_DENY_NS,
+    ],
     TAG_RULE: [
         TAG_DENY_ALL,
         TAG_ALLOW_ALL,

@@ -169,6 +169,13 @@ def _bench_run_from_parsed(
             run.serve_full_rebuild_s = float(serve["full_rebuild_s"])
         if isinstance(serve.get("queries_per_sec"), (int, float)):
             run.serve_queries_per_sec = float(serve["queries_per_sec"])
+    tiers = detail.get("tiers")
+    if isinstance(tiers, dict):
+        run.tiers_active = bool(tiers.get("active"))
+        if isinstance(tiers.get("anp_count"), int):
+            run.tiers_anp_count = int(tiers["anp_count"])
+        if isinstance(tiers.get("resolve_s"), (int, float)):
+            run.tiers_resolve_s = float(tiers["resolve_s"])
     mesh = detail.get("mesh_scaling") or {}
     rows = [
         r

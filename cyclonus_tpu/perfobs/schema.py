@@ -85,6 +85,14 @@ class PerfRun:
     serve_incremental_apply_s: Optional[float] = None
     serve_full_rebuild_s: Optional[float] = None
     serve_queries_per_sec: Optional[float] = None
+    # detail.tiers — the precedence-tier bench leg (None/False: leg
+    # skipped or an older artifact).  Warn-only in the sentinel like
+    # class_compression_ratio: the leg's own oracle spot-parity
+    # assertion already fails the bench on correctness, so resolve_s
+    # gates only trends.
+    tiers_active: bool = False
+    tiers_anp_count: Optional[int] = None
+    tiers_resolve_s: Optional[float] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -111,6 +119,9 @@ class PerfRun:
             "serve_incremental_apply_s": self.serve_incremental_apply_s,
             "serve_full_rebuild_s": self.serve_full_rebuild_s,
             "serve_queries_per_sec": self.serve_queries_per_sec,
+            "tiers_active": self.tiers_active,
+            "tiers_anp_count": self.tiers_anp_count,
+            "tiers_resolve_s": self.tiers_resolve_s,
             "error": self.error,
             "metric": self.metric,
         }

@@ -42,9 +42,11 @@ from .schema import PerfRun
 #: an engine regression would recreate the r03/r04 confusion; the
 #: cold-start forensics and failure classes cover it instead — and
 #: serve_churn has its own warn-only fields (serve_incremental_apply_s
-#: / serve_queries_per_sec) whose workload knobs may differ per round
+#: / serve_queries_per_sec) whose workload knobs may differ per round,
+#: and tiers likewise rides warn-only (tiers_resolve_s; BENCH_TIERS_*
+#: knobs shape the leg)
 _DEDICATED_PHASES = frozenset(
-    {"warmup", "eval", "backend_init_join", "serve_churn"}
+    {"warmup", "eval", "backend_init_join", "serve_churn", "tiers"}
 )
 
 
@@ -303,6 +305,29 @@ def gate(
                 f"baseline: candidate "
                 f"{candidate.serve_queries_per_sec:g}/s vs best "
                 f"{best_qps:g}/s — reported only (warn, not fail)"
+            )
+
+    # --- precedence-tier leg: WARN, never fail --------------------------
+    # same discipline as serve: the leg's oracle spot-parity assertion
+    # already fails the bench on correctness, and BENCH_TIERS_* knobs
+    # may legitimately differ per round — resolve_s degradation is a
+    # note for a human
+    resolve_base = [
+        r.tiers_resolve_s
+        for r in baselines
+        if isinstance(r.tiers_resolve_s, (int, float))
+    ]
+    if resolve_base and isinstance(
+        candidate.tiers_resolve_s, (int, float)
+    ):
+        best_resolve = min(resolve_base)
+        if candidate.tiers_resolve_s > 2.0 * best_resolve:
+            notes.append(
+                "WARNING: tiers_resolve_s degraded >2x vs baseline: "
+                f"candidate {candidate.tiers_resolve_s:g}s vs best "
+                f"{best_resolve:g}s — reported only (warn, not fail); "
+                "check the tier resolution epilogue before the next "
+                "round"
             )
 
     # --- per-phase bounds: every phase both sides know ------------------

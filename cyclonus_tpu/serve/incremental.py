@@ -215,6 +215,7 @@ class IncrementalEngine:
         namespaces: Dict[str, Dict[str, str]],
         *,
         class_compress: Optional[str] = None,
+        tiers=None,
     ):
         # compact=False: dead-target compaction bakes pod state into the
         # RULE tensors, which a pod delta can invalidate — a
@@ -225,6 +226,7 @@ class IncrementalEngine:
             namespaces,
             compact=False,
             class_compress=class_compress,
+            tiers=tiers,
         )
         self._class_compress = class_compress
         # class-patch support: the per-pod signature matrix and the
@@ -569,14 +571,37 @@ class IncrementalEngine:
 
     # --- rule-slab patches ----------------------------------------------
 
-    def patch_policy(self, policy: Policy) -> None:
-        """Re-encode the rule slabs for a changed policy set and patch
-        them into the live buffer; Ineligible when any slab changes its
-        bucketed shape."""
+    def patch_policy(self, policy: Policy, tiers=None) -> None:
+        """Re-encode the rule slabs for a changed policy/tier set and
+        patch them into the live buffer; Ineligible when any slab changes
+        its bucketed shape.
+
+        `tiers` must be the service's CURRENT TierSet whenever the live
+        engine carries tier slabs — even for a pure NetworkPolicy delta.
+        The tier rows index the SHARED selector table this re-encode
+        rebuilds, so re-encoding the NP directions alone would leave the
+        tier slabs pointing at selector ids of the OLD table: a latent
+        verdict≡allow-only assumption the lattice exposed (every
+        pre-tier caller could drop the table because bool-OR rules were
+        all re-encoded together).  The bucketed-shape comparison below
+        covers the tier slabs exactly like the NP slabs."""
         eng = self.engine
         enc = eng.encoding
         vocab = enc.cluster.vocab
-        ingress, egress, sel_arrays, n_sel = encode_directions(policy, vocab)
+        had_tiers = "tiers" in eng._tensors
+        if had_tiers and not tiers:
+            raise Ineligible(
+                "live engine carries tier slabs but the patch has no "
+                "TierSet (tensor structure change)"
+            )
+        if tiers and not had_tiers:
+            raise Ineligible(
+                "tier slabs appear on a tier-less engine (tensor "
+                "structure change)"
+            )
+        ingress, egress, sel_arrays, n_sel, tier_enc = encode_directions(
+            policy, vocab, tiers=tiers if had_tiers else None
+        )
         if ingress.host_ip_rows or egress.host_ip_rows:
             raise Ineligible(
                 "changed policy set introduces host-evaluated (IPv6) "
@@ -590,6 +615,11 @@ class IncrementalEngine:
             "ingress": engine_api._direction_tensors(ingress),
             "egress": engine_api._direction_tensors(egress),
         }
+        if tier_enc is not None:
+            new["tiers"] = {
+                "ingress": engine_api._tier_tensors(tier_enc[0]),
+                "egress": engine_api._tier_tensors(tier_enc[1]),
+            }
         pstats = None
         if eng._partition_stats is not None:
             pstats = {}
@@ -611,33 +641,47 @@ class IncrementalEngine:
                     f"selector slab {k} changes bucket "
                     f"{old[k].shape} -> {merged[k].shape}"
                 )
-        for direction in ("ingress", "egress"):
-            od, nd = old[direction], merged[direction]
+        def _check_slab_dict(label: str, od: Dict, nd: Dict) -> None:
             if set(od) != set(nd):
-                raise Ineligible(f"{direction} slab key set changed")
+                raise Ineligible(f"{label} slab key set changed")
             for k in od:
                 if k == "port_spec":
                     if set(od[k]) != set(nd[k]) or any(
                         od[k][s].shape != nd[k][s].shape for s in od[k]
                     ):
-                        raise Ineligible(
-                            f"{direction} port_spec changes bucket"
-                        )
+                        raise Ineligible(f"{label} port_spec changes bucket")
                 elif od[k].shape != nd[k].shape:
                     raise Ineligible(
-                        f"{direction} slab {k} changes bucket "
+                        f"{label} slab {k} changes bucket "
                         f"{od[k].shape} -> {nd[k].shape}"
                     )
+
+        for direction in ("ingress", "egress"):
+            _check_slab_dict(direction, old[direction], merged[direction])
+            if had_tiers:
+                _check_slab_dict(
+                    f"tiers.{direction}",
+                    old["tiers"][direction],
+                    merged["tiers"][direction],
+                )
         patch = self.main_patchset()
+
+        def _stage_slab_dict(prefix: tuple, d: Dict) -> None:
+            for k, v in d.items():
+                if k == "port_spec":
+                    for s, arr in v.items():
+                        patch.stage_leaf(prefix + ("port_spec", s), arr)
+                else:
+                    patch.stage_leaf(prefix + (k,), v)
+
         for k in _SEL_LEAVES:
             patch.stage_leaf((k,), merged[k])
         for direction in ("ingress", "egress"):
-            for k, v in merged[direction].items():
-                if k == "port_spec":
-                    for s, arr in v.items():
-                        patch.stage_leaf((direction, "port_spec", s), arr)
-                else:
-                    patch.stage_leaf((direction, k), v)
+            _stage_slab_dict((direction,), merged[direction])
+            if had_tiers:
+                _stage_slab_dict(
+                    ("tiers", direction), merged["tiers"][direction]
+                )
         # the same CYCLONUS_SLAB_MAX_BYTES rule the pod/ns path obeys:
         # a slab patch stages idx+vals comparable to the slab size, and
         # past the budget the full rebuild (one packed transfer, no
@@ -653,6 +697,8 @@ class IncrementalEngine:
             old[k] = merged[k]
         for direction in ("ingress", "egress"):
             old[direction] = merged[direction]
+        if had_tiers:
+            old["tiers"] = merged["tiers"]
         self.flush_main(patch)
         # raw encoding follows (firing_components and the analysis layer
         # read it) + the derived host state
@@ -662,6 +708,9 @@ class IncrementalEngine:
             sel_arrays
         )
         enc.n_selectors = n_sel
+        if had_tiers:
+            enc.tiers = tier_enc
+            eng.tiers = tiers
         if pstats is not None:
             eng._partition_stats = pstats
         from ..engine.encoding import PEER_IP

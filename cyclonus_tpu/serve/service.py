@@ -40,6 +40,11 @@ from ..kube.netpol import NAMESPACE_DEFAULT, NetworkPolicy
 from ..kube.yaml_io import parse_policy_dict
 from ..matcher.builder import build_network_policies
 from ..telemetry import instruments as ti
+from ..tiers.model import (
+    AdminNetworkPolicy,
+    BaselineAdminNetworkPolicy,
+    TierSet,
+)
 from ..utils import guards
 from ..utils.tracing import phase
 from ..worker.model import Delta, FlowQuery, Verdict
@@ -161,6 +166,7 @@ class VerdictService:
         *,
         simplify: bool = True,
         class_compress: Optional[str] = None,
+        tiers: Optional[TierSet] = None,
     ):
         self._lock = guards.lock()
         self._simplify = simplify
@@ -174,6 +180,16 @@ class VerdictService:
         self.netpols: Dict[str, NetworkPolicy] = {
             f"{p.effective_namespace()}/{p.name}": p for p in policies
         }
+        # precedence-tier authoritative state (cyclonus_tpu/tiers):
+        # ANPs keyed by cluster-scoped name, at most one BANP — the
+        # same replace-wholesale discipline as the dicts above, so the
+        # apply_pending rollback snapshot covers them shallowly
+        tiers = tiers or TierSet()
+        tiers.validate()
+        self.anps: Dict[str, AdminNetworkPolicy] = {
+            a.name: a for a in tiers.anps
+        }
+        self.banp: Optional[BaselineAdminNetworkPolicy] = tiers.banp
         self._queue: List[Delta] = []
         self._epoch = 0
         self._pending_since: Optional[float] = None
@@ -201,6 +217,14 @@ class VerdictService:
             self._simplify, list(self.netpols.values())
         )
 
+    def _tier_set(self) -> Optional[TierSet]:
+        """The authoritative tier dicts as the TierSet the engine
+        consumes — None when empty, so a tier-free service keeps the
+        networkingv1-only fast path (no tier slabs, no epilogue)."""
+        if not self.anps and self.banp is None:
+            return None
+        return TierSet(anps=list(self.anps.values()), banp=self.banp)
+
     @guards.holds("self._lock")
     def _rebuild(self) -> float:
         """Full rebuild from the authoritative dicts (the fallback every
@@ -214,6 +238,7 @@ class VerdictService:
             list(self.pods.values()),
             dict(self.namespaces),
             class_compress=self._class_compress,
+            tiers=self._tier_set(),
         )
         self._pod_idx = self._inc.engine.pod_index()
         dt = time.perf_counter() - t0
@@ -254,12 +279,12 @@ class VerdictService:
         return self.apply_pending()
 
     def _apply_to_state(
-        self, d: Delta, pol: Optional[NetworkPolicy] = None
+        self, d: Delta, pol=None
     ) -> Optional[Tuple[str, str]]:
         """Fold one delta into the authoritative dicts; returns the
         engine-visible op it implies, or None for a no-op (unknown key,
         value already current).  `pol` is _validate_delta's parse of a
-        policy_upsert payload, reused here."""
+        policy_upsert / anp_upsert / banp_upsert payload, reused here."""
         key = f"{d.namespace}/{d.name}"
         if d.kind == "pod_add":
             pod = (d.namespace, d.name, dict(d.labels or {}), d.ip or "")
@@ -310,15 +335,40 @@ class VerdictService:
                 return None
             del self.netpols[pkey]
             return ("policy", pkey)
+        # precedence-tier objects (cluster-scoped: d.namespace unused).
+        # `pol` is _validate_delta's parse, same single-parse discipline
+        # as policy_upsert.
+        if d.kind == "anp_upsert":
+            if pol is None:
+                pol = AdminNetworkPolicy.from_dict(d.policy or {})
+            if self.anps.get(pol.name) == pol:
+                return None
+            self.anps[pol.name] = pol
+            return ("tier", pol.name)
+        if d.kind == "anp_delete":
+            if d.name not in self.anps:
+                return None
+            del self.anps[d.name]
+            return ("tier", d.name)
+        if d.kind == "banp_upsert":
+            if pol is None:
+                pol = BaselineAdminNetworkPolicy.from_dict(d.policy or {})
+            if self.banp == pol:
+                return None
+            self.banp = pol
+            return ("tier", "banp")
+        if d.kind == "banp_delete":
+            if self.banp is None:
+                return None
+            self.banp = None
+            return ("tier", "banp")
         raise ValueError(f"unknown delta kind {d.kind!r}")
 
-    def _validate_delta(
-        self, d: Delta
-    ) -> Tuple[Optional[str], Optional[NetworkPolicy]]:
+    def _validate_delta(self, d: Delta) -> Tuple[Optional[str], object]:
         """Reject a malformed delta BEFORE any state mutates (a mid-batch
         raise after mutation would leave the engine silently diverged
         from the dicts).  Returns (rejection reason or None, the parsed
-        policy for policy_upserts) — the parse is handed to
+        policy for policy/anp/banp upserts) — the parse is handed to
         _apply_to_state so each policy event parses once, not twice.
 
         The solo compile runs under the LIVE simplify setting: a policy
@@ -341,6 +391,45 @@ class VerdictService:
             if not (pol.name or d.name):
                 return "policy_upsert needs a name (payload or Name key)", None
             return None, pol
+        if d.kind in ("anp_upsert", "banp_upsert"):
+            # from_dict runs .validate(): action vocabulary, priority
+            # bounds, port-range sanity — all rejected before any state
+            # mutates, same contract as the policy_upsert compile probe
+            cls = (
+                AdminNetworkPolicy
+                if d.kind == "anp_upsert"
+                else BaselineAdminNetworkPolicy
+            )
+            payload = dict(d.policy or {})
+            # the YAML path rejects a mis-routed object via
+            # parse_tier_object's kind dispatch; the wire path must
+            # too — from_dict ignores `kind`, so without this an ANP
+            # dict sent as banp_upsert would silently install as the
+            # baseline tier (and a junk payload as an empty match-
+            # nothing BANP, wholesale replacing the real one)
+            if payload.get("kind") != cls.__name__:
+                return (
+                    f"{d.kind} payload kind {payload.get('kind')!r} != "
+                    f"{cls.__name__!r}",
+                    None,
+                )
+            if d.kind == "anp_upsert" and d.name:
+                # name-from-Delta, policy_upsert style — injected before
+                # the parse because validate() requires a name
+                md = dict(payload.get("metadata") or {})
+                md.setdefault("name", d.name)
+                payload["metadata"] = md
+            try:
+                pol = cls.from_dict(payload)
+            except Exception as e:
+                return (
+                    f"invalid {cls.__name__} payload: "
+                    f"{type(e).__name__}: {e}",
+                    None,
+                )
+            return None, pol
+        if d.kind == "banp_delete":
+            return None, None  # the singleton needs no Name
         if d.kind != "ns_labels" and not d.name:
             return f"{d.kind} needs a Name", None
         if d.kind == "pod_add" and not _parseable_ip(d.ip or ""):
@@ -386,6 +475,8 @@ class VerdictService:
                 dict(self.pods),
                 dict(self.namespaces),
                 dict(self.netpols),
+                dict(self.anps),
+                self.banp,
             )
             ops = []
             try:
@@ -417,7 +508,13 @@ class VerdictService:
                 # so the rebuild succeeds and later batches are clean.
                 import logging
 
-                self.pods, self.namespaces, self.netpols = snap
+                (
+                    self.pods,
+                    self.namespaces,
+                    self.netpols,
+                    self.anps,
+                    self.banp,
+                ) = snap
                 try:
                     self._rebuild()
                 except Exception:
@@ -469,6 +566,7 @@ class VerdictService:
         pod_ops = [o for o in ops if o[0] in ("pod_set", "pod_new", "pod_del")]
         ns_ops = [o for o in ops if o[0] == "ns"]
         policy_changed = any(o[0] == "policy" for o in ops)
+        tier_changed = any(o[0] == "tier" for o in ops)
         n = eng.encoding.cluster.n_pods
         touched = len(pod_ops) + len(ns_ops)
         limit = max(_churn_row_limit(), int(_churn_frac_limit() * max(n, 1)))
@@ -515,9 +613,17 @@ class VerdictService:
         inc.flush_main(patch)
         inc.flush_class(class_patch)
         mode = "incremental"
-        if policy_changed:
-            self._policy = self._compiled_policy()
-            inc.patch_policy(self._policy)  # rebuilds class state if active
+        if policy_changed or tier_changed:
+            # tier slabs patch like rule slabs: patch_policy re-encodes
+            # the NP directions + the SHARED selector table + the tier
+            # slabs together (a tier delta can grow the table the NP
+            # rows index, and vice versa), and raises Ineligible on any
+            # bucketed-shape change — including the tier slabs appearing
+            # on a tier-less engine or vanishing entirely, which is a
+            # tensor-structure change only the full rebuild can make
+            if policy_changed:
+                self._policy = self._compiled_policy()
+            inc.patch_policy(self._policy, tiers=self._tier_set())
             if eng._class_state is not None:
                 mode = "class_rebuild"
         elif eng._class_state is not None:
@@ -653,6 +759,7 @@ class VerdictService:
                     "classes": cc["classes"],
                     "ratio": cc["ratio"],
                 },
+                "tiers": eng.tier_stats(),
                 "query_latency": {
                     "count": sum(
                         s.get("count", 0) for s in hist.get("samples") or []
@@ -678,7 +785,8 @@ class VerdictService:
         mismatch; returns check stats."""
         import random as _random
 
-        from ..analysis.oracle import oracle_verdicts, traffic_for_cell
+        from ..analysis.oracle import traffic_for_cell
+        from ..matcher.tiered import TieredPolicy, tiered_oracle_verdicts
 
         rng = rng or _random.Random(0)
         with self._lock:
@@ -686,12 +794,17 @@ class VerdictService:
             pods_list = list(self.pods.values())
             namespaces = dict(self.namespaces)
             policy = self._policy
+            tiers = self._tier_set()
+            # compiled ONCE (TieredPolicy re-validates + recompiles port
+            # matchers at construction; the loop below calls per cell)
+            _tiered = TieredPolicy(policy, tiers) if tiers else None
             fresh = TpuPolicyEngine(
                 policy,
                 pods_list,
                 namespaces,
                 compact=False,
                 class_compress=self._class_compress,
+                tiers=tiers,
             )
             n = len(pods_list)
             if n == 0:
@@ -727,7 +840,11 @@ class VerdictService:
                 t = traffic_for_cell(
                     pods_list, namespaces, cases[qi], si, di
                 )
-                want = oracle_verdicts(policy, t)
+                want = (
+                    _tiered.is_traffic_allowed(t)
+                    if _tiered is not None
+                    else tiered_oracle_verdicts(policy, None, t)
+                )
                 got = tuple(
                     bool(np.asarray(getattr(g_fresh, name))[qi]
                          [si if name != "ingress" else di]

@@ -1,0 +1,43 @@
+"""Precedence tiers: AdminNetworkPolicy / BaselineAdminNetworkPolicy
+over networkingv1 NetworkPolicy (docs/DESIGN.md "Precedence tiers").
+
+Layout:
+  model.py  - ANP/BANP object model + the TierSet resolution order
+  fuzz.py   - the seeded policy-set fuzzer: adversarial corner cases,
+              differentially gated kernel-vs-oracle (the subsystem's
+              correctness engine; `cyclonus-tpu fuzz`)
+
+The scalar lattice oracle lives in matcher/tiered.py (next to the
+networkingv1 oracle it extends); the slab encoding in
+engine/encoding.py (TierDirectionEncoding); the first-match-by-priority
+resolution epilogue in engine/kernel.py + engine/tiled.py.
+
+fuzz is imported lazily: the model must stay importable without paying
+the engine/jax import.
+"""
+
+from .model import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    ACTION_PASS,
+    AdminNetworkPolicy,
+    BaselineAdminNetworkPolicy,
+    TierPort,
+    TierRule,
+    TierScope,
+    TierSet,
+    parse_tier_object,
+)
+
+__all__ = [
+    "ACTION_ALLOW",
+    "ACTION_DENY",
+    "ACTION_PASS",
+    "AdminNetworkPolicy",
+    "BaselineAdminNetworkPolicy",
+    "TierPort",
+    "TierRule",
+    "TierScope",
+    "TierSet",
+    "parse_tier_object",
+]

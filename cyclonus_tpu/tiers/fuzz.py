@@ -1,0 +1,570 @@
+"""Seeded policy-set fuzzer: the precedence-tier subsystem's
+correctness engine (`cyclonus-tpu fuzz`, `make fuzz`).
+
+Every seed deterministically generates an adversarial scenario —
+overlapping ANP priorities, Pass-chains, overlapping CIDRs with
+excepts, empty selectors, endPort ranges, SCTP, sentinel-adjacent port
+values (0 / 1 / 65535, the encoder's 0-default and -1 pads live next
+door), IPv6 pods against the pod_ip_valid mask — and differentially
+checks the engine against the scalar lattice oracle
+(matcher/tiered.py):
+
+  * grid truth tables BIT-IDENTICAL, dense AND class-compressed
+    (CYCLONUS_CLASS_COMPRESS both off and forced);
+  * the tiled counts engine equal to the oracle-checked grid sums;
+  * evaluate_pairs spot checks on sampled cells.
+
+A mismatch raises FuzzMismatch carrying the seed + first divergent
+cell, so any failure reproduces with `cyclonus-tpu fuzz --seed N
+--seeds 1`.  Seeds also generate tier-free scenarios (~1 in 4): the
+differential gate doubles as the proof that zero ANP/BANP objects keep
+the networkingv1-only path bit-identical to the plain oracle.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.api import PortCase, TpuPolicyEngine
+from ..kube.netpol import (
+    IPBlock,
+    IntOrString,
+    LabelSelector,
+    LabelSelectorRequirement,
+    NetworkPolicy,
+    NetworkPolicyEgressRule,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+)
+from ..matcher.builder import build_network_policies
+from ..matcher.core import Policy
+from ..matcher.tiered import TieredPolicy
+from .model import (
+    AdminNetworkPolicy,
+    BaselineAdminNetworkPolicy,
+    TierPort,
+    TierRule,
+    TierScope,
+    TierSet,
+)
+
+PodTuple = Tuple[str, str, Dict[str, str], str]
+
+
+class FuzzMismatch(AssertionError):
+    """The differential gate failed; the message carries the seed and
+    the first divergent cell for one-command reproduction."""
+
+
+@dataclass
+class FuzzCase:
+    seed: int
+    pods: List[PodTuple]
+    namespaces: Dict[str, Dict[str, str]]
+    netpols: List[NetworkPolicy]
+    tiers: Optional[TierSet]
+    cases: List[PortCase]
+    simplify: bool = True
+
+
+@dataclass
+class FuzzReport:
+    seeds: List[int] = field(default_factory=list)
+    cells_checked: int = 0
+    pair_checks: int = 0
+    tiered_seeds: int = 0
+
+    def to_dict(self) -> Dict:
+        return {
+            "seeds": list(self.seeds),
+            "cells_checked": self.cells_checked,
+            "pair_checks": self.pair_checks,
+            "tiered_seeds": self.tiered_seeds,
+        }
+
+
+# --- scenario generation ---------------------------------------------------
+
+_NS_NAMES = ("x", "y", "z", "w")
+_POD_NAMES = ("a", "b", "c", "d")
+#: sentinel-adjacent and ordinary port values the generator draws from:
+#: 0 and 1 sit next to the encoder's 0-default item_port fill, 65535 at
+#: the int16 edge, 80/81/8080 are ordinary
+_PORT_POOL = (0, 1, 79, 80, 81, 8080, 65535)
+_NAMED_PORTS = ("serve-80-tcp", "serve-81-udp", "serve-82-sctp", "http")
+_PROTOCOLS = ("TCP", "UDP", "SCTP")
+#: overlapping CIDR shapes over the 10.0.0.0/8 pod range
+_CIDRS = (
+    ("10.0.0.0/8", ()),
+    ("10.0.1.0/24", ()),
+    ("10.0.0.0/16", ("10.0.1.0/24",)),
+    ("10.0.1.0/24", ("10.0.1.128/25",)),
+    ("10.0.0.0/30", ()),
+)
+
+
+def _rand_selector(rng: random.Random, empty_ok: bool = True) -> LabelSelector:
+    roll = rng.random()
+    if empty_ok and roll < 0.2:
+        return LabelSelector.make()  # empty: matches everything
+    if roll < 0.75:
+        key = rng.choice(("pod", "app", "tier"))
+        val = rng.choice(_POD_NAMES + ("web", "db"))
+        return LabelSelector.make({key: val})
+    op = rng.choice((OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST))
+    key = rng.choice(("pod", "app"))
+    values = (
+        tuple(rng.sample(_POD_NAMES, rng.randint(1, 2)))
+        if op in (OP_IN, OP_NOT_IN)
+        else ()
+    )
+    return LabelSelector.make(
+        match_expressions=[
+            LabelSelectorRequirement(key=key, operator=op, values=values)
+        ]
+    )
+
+
+def _rand_ns_selector(rng: random.Random) -> LabelSelector:
+    roll = rng.random()
+    if roll < 0.25:
+        return LabelSelector.make()
+    return LabelSelector.make({"ns": rng.choice(_NS_NAMES)})
+
+
+def _rand_scope(rng: random.Random) -> TierScope:
+    if rng.random() < 0.5:
+        return TierScope(namespace_selector=_rand_ns_selector(rng))
+    return TierScope(
+        namespace_selector=_rand_ns_selector(rng),
+        pod_selector=_rand_selector(rng),
+    )
+
+
+def _rand_tier_ports(rng: random.Random) -> Optional[List[TierPort]]:
+    roll = rng.random()
+    if roll < 0.4:
+        return None  # all ports
+    ports: List[TierPort] = []
+    for _ in range(rng.randint(1, 2)):
+        kind = rng.random()
+        proto = rng.choice(_PROTOCOLS)
+        if kind < 0.4:
+            ports.append(
+                TierPort(protocol=proto, port=IntOrString(rng.choice(_PORT_POOL)))
+            )
+        elif kind < 0.7:
+            lo = rng.choice((0, 1, 79, 80, 65530))
+            hi = min(lo + rng.choice((0, 1, 5, 1000)), 65535)
+            ports.append(
+                TierPort(protocol=proto, port=IntOrString(lo), end_port=hi)
+            )
+        else:
+            ports.append(
+                TierPort(
+                    protocol="TCP",
+                    port=IntOrString(rng.choice(_NAMED_PORTS)),
+                )
+            )
+    return ports
+
+
+def _rand_np_ports(rng: random.Random) -> List[NetworkPolicyPort]:
+    n = rng.randint(0, 2)
+    out = []
+    for _ in range(n):
+        kind = rng.random()
+        proto = rng.choice(_PROTOCOLS + (None,))
+        if kind < 0.3:
+            out.append(NetworkPolicyPort(protocol=proto, port=None))
+        elif kind < 0.6:
+            out.append(
+                NetworkPolicyPort(
+                    protocol=proto, port=IntOrString(rng.choice(_PORT_POOL))
+                )
+            )
+        elif kind < 0.8:
+            lo = rng.choice((1, 80, 8080))
+            out.append(
+                NetworkPolicyPort(
+                    protocol=proto,
+                    port=IntOrString(lo),
+                    end_port=lo + rng.choice((0, 1, 100)),
+                )
+            )
+        else:
+            out.append(
+                NetworkPolicyPort(
+                    protocol=proto,
+                    port=IntOrString(rng.choice(_NAMED_PORTS)),
+                )
+            )
+    return out
+
+
+def _rand_np_peers(rng: random.Random) -> List[NetworkPolicyPeer]:
+    n = rng.randint(0, 2)
+    out = []
+    for _ in range(n):
+        if rng.random() < 0.3:
+            cidr, excepts = rng.choice(_CIDRS)
+            out.append(
+                NetworkPolicyPeer(ip_block=IPBlock.make(cidr, list(excepts)))
+            )
+        else:
+            out.append(
+                NetworkPolicyPeer(
+                    pod_selector=_rand_selector(rng)
+                    if rng.random() < 0.7
+                    else None,
+                    namespace_selector=_rand_ns_selector(rng)
+                    if rng.random() < 0.7
+                    else None,
+                )
+            )
+    return [
+        p
+        for p in out
+        if p.ip_block is not None
+        or p.pod_selector is not None
+        or p.namespace_selector is not None
+    ]
+
+
+def build_fuzz_case(seed: int) -> FuzzCase:
+    """Deterministic adversarial scenario for `seed` (module docstring
+    lists the corner-case families)."""
+    rng = random.Random(seed)
+    n_ns = rng.randint(2, 4)
+    ns_names = list(_NS_NAMES[:n_ns])
+    namespaces = {}
+    for ns in ns_names:
+        labels = {"ns": ns}
+        if rng.random() < 0.3:
+            labels["team"] = rng.choice(("red", "blue"))
+        if rng.random() < 0.1:
+            labels = {}  # label-less namespace
+        namespaces[ns] = labels
+    pods: List[PodTuple] = []
+    for ns in ns_names:
+        for name in _POD_NAMES[: rng.randint(2, 3)]:
+            labels = {"pod": name}
+            if rng.random() < 0.3:
+                labels["app"] = rng.choice(("web", "db"))
+            if rng.random() < 0.05:
+                labels = {}
+            if rng.random() < 0.06:
+                ip = f"fd00::{len(pods) + 1:x}"  # IPv6: pod_ip_valid mask
+            else:
+                # inside/outside the overlapping CIDR pool on purpose
+                ip = f"10.0.{rng.choice((0, 1, 2))}.{rng.randint(1, 250)}"
+            pods.append((ns, name, labels, ip))
+
+    netpols: List[NetworkPolicy] = []
+    for i in range(rng.randint(0, 3)):
+        ptypes = rng.choice((["Ingress"], ["Egress"], ["Ingress", "Egress"]))
+        spec = NetworkPolicySpec(
+            pod_selector=_rand_selector(rng),
+            policy_types=list(ptypes),
+        )
+        if "Ingress" in ptypes:
+            spec.ingress = [
+                NetworkPolicyIngressRule(
+                    ports=_rand_np_ports(rng), from_=_rand_np_peers(rng)
+                )
+                for _ in range(rng.randint(0, 2))
+            ]
+        if "Egress" in ptypes:
+            spec.egress = [
+                NetworkPolicyEgressRule(
+                    ports=_rand_np_ports(rng), to=_rand_np_peers(rng)
+                )
+                for _ in range(rng.randint(0, 2))
+            ]
+        netpols.append(
+            NetworkPolicy(
+                name=f"np-{i}", namespace=rng.choice(ns_names), spec=spec
+            )
+        )
+
+    tiers: Optional[TierSet] = None
+    if rng.random() < 0.75:
+        anps = []
+        # overlapping priorities on purpose: the (priority, name) total
+        # order must resolve identically kernel- and oracle-side
+        prio_pool = (0, 1, 1, 5, 5, 50, 1000)
+        for i in range(rng.randint(0, 3)):
+            rules_in = [
+                TierRule(
+                    action=rng.choice(("Allow", "Deny", "Pass", "Pass")),
+                    peers=[_rand_scope(rng) for _ in range(rng.randint(1, 2))],
+                    ports=_rand_tier_ports(rng),
+                )
+                for _ in range(rng.randint(0, 2))
+            ]
+            rules_eg = [
+                TierRule(
+                    action=rng.choice(("Allow", "Deny", "Pass")),
+                    peers=[_rand_scope(rng) for _ in range(rng.randint(1, 2))],
+                    ports=_rand_tier_ports(rng),
+                )
+                for _ in range(rng.randint(0, 2))
+            ]
+            anps.append(
+                AdminNetworkPolicy(
+                    name=f"anp-{i}",
+                    priority=rng.choice(prio_pool),
+                    subject=_rand_scope(rng),
+                    ingress=rules_in,
+                    egress=rules_eg,
+                )
+            )
+        banp = None
+        if rng.random() < 0.5:
+            banp = BaselineAdminNetworkPolicy(
+                subject=_rand_scope(rng),
+                ingress=[
+                    TierRule(
+                        action=rng.choice(("Allow", "Deny")),
+                        peers=[_rand_scope(rng)],
+                        ports=_rand_tier_ports(rng),
+                    )
+                    for _ in range(rng.randint(0, 2))
+                ],
+                egress=[
+                    TierRule(
+                        action=rng.choice(("Allow", "Deny")),
+                        peers=[_rand_scope(rng)],
+                        ports=_rand_tier_ports(rng),
+                    )
+                    for _ in range(rng.randint(0, 1))
+                ],
+            )
+        ts = TierSet(anps=anps, banp=banp)
+        tiers = ts if ts else None
+
+    cases = [
+        PortCase(80, "serve-80-tcp", "TCP"),
+        PortCase(81, "serve-81-udp", "UDP"),
+        PortCase(rng.choice(_PORT_POOL), "", rng.choice(_PROTOCOLS)),
+    ]
+    if rng.random() < 0.5:
+        cases.append(PortCase(82, "serve-82-sctp", "SCTP"))
+    if rng.random() < 0.3:
+        cases.append(PortCase(65535, "", "TCP"))
+
+    return FuzzCase(
+        seed=seed,
+        pods=pods,
+        namespaces=namespaces,
+        netpols=netpols,
+        tiers=tiers,
+        cases=cases,
+        simplify=rng.random() < 0.5,
+    )
+
+
+# --- the differential gate -------------------------------------------------
+
+
+def _oracle_table(
+    policy: Policy,
+    tiers: Optional[TierSet],
+    pods: List[PodTuple],
+    namespaces: Dict[str, Dict[str, str]],
+    cases: List[PortCase],
+) -> np.ndarray:
+    """[Q, N, N, 3] bool oracle truth table (ingress, egress, combined),
+    indexed [q, src, dst]."""
+    from ..analysis.oracle import traffic_for_cell
+
+    oracle = TieredPolicy(policy, tiers)
+    n = len(pods)
+    out = np.zeros((len(cases), n, n, 3), dtype=bool)
+    for qi, case in enumerate(cases):
+        for si in range(n):
+            for di in range(n):
+                out[qi, si, di] = oracle.is_traffic_allowed(
+                    traffic_for_cell(pods, namespaces, case, si, di)
+                )
+    return out
+
+
+def _engine_table(engine: TpuPolicyEngine, cases: List[PortCase]) -> np.ndarray:
+    grid = engine.evaluate_grid(cases)
+    ingress = np.asarray(grid.ingress)  # [Q, dst, src]
+    egress = np.asarray(grid.egress)  # [Q, src, dst]
+    combined = np.asarray(grid.combined)
+    return np.stack(
+        [np.swapaxes(ingress, 1, 2), egress, combined], axis=-1
+    )  # [Q, src, dst, 3]
+
+
+def run_seed(
+    seed: int,
+    *,
+    modes: Tuple[str, ...] = ("0", "1"),
+    check_counts: bool = True,
+    pair_samples: int = 16,
+) -> Dict:
+    """The per-seed differential gate (module docstring).  Returns check
+    stats; raises FuzzMismatch on any divergence."""
+    fc = build_fuzz_case(seed)
+    policy = build_network_policies(fc.simplify, fc.netpols)
+    want = _oracle_table(policy, fc.tiers, fc.pods, fc.namespaces, fc.cases)
+    n = len(fc.pods)
+    rng = random.Random(seed ^ 0x5EED)
+    pair_checks = 0
+    for mode in modes:
+        engine = TpuPolicyEngine(
+            policy,
+            fc.pods,
+            fc.namespaces,
+            tiers=fc.tiers,
+            class_compress=mode,
+        )
+        got = _engine_table(engine, fc.cases)
+        if not np.array_equal(got, want):
+            bad = np.argwhere(got != want)
+            qi, si, di, ki = (int(x) for x in bad[0])
+            raise FuzzMismatch(
+                f"seed {seed} (class_compress={mode}): engine diverges "
+                f"from the tiered oracle at case={fc.cases[qi]} "
+                f"src={fc.pods[si][:2]} dst={fc.pods[di][:2]} "
+                f"component={('ingress', 'egress', 'combined')[ki]}: "
+                f"engine={bool(got[qi, si, di, ki])} "
+                f"oracle={bool(want[qi, si, di, ki])} "
+                f"({bad.shape[0]} divergent cells)"
+            )
+        if check_counts:
+            sums = {
+                "ingress": int(want[..., 0].sum()),
+                "egress": int(want[..., 1].sum()),
+                "combined": int(want[..., 2].sum()),
+            }
+            counts = engine.evaluate_grid_counts(fc.cases, block=8)
+            got_counts = {k: counts[k] for k in sums}
+            if got_counts != sums:
+                raise FuzzMismatch(
+                    f"seed {seed} (class_compress={mode}): counts engine "
+                    f"{got_counts} != oracle sums {sums}"
+                )
+        if mode == "1":
+            # class-reduction soundness under the lattice: co-classed
+            # pods must be indistinguishable to the TIERED oracle
+            # (analysis/classes.py tier note) — the compressed truth
+            # table above proves the gather; this proves the classes
+            pc = engine.pod_classes()
+            if pc is not None:
+                from ..analysis.classes import audit_class_reduction
+
+                res = audit_class_reduction(
+                    policy,
+                    fc.pods,
+                    fc.namespaces,
+                    fc.cases,
+                    pc,
+                    rng=random.Random(seed ^ 0xC1A5),
+                    tiers=fc.tiers,
+                )
+                if not res["ok"]:
+                    raise FuzzMismatch(
+                        f"seed {seed}: class-reduction audit found "
+                        f"{len(res['violations'])} violations under the "
+                        f"tiered oracle; first {res['violations'][0]}"
+                    )
+        if n and pair_samples:
+            pairs = [
+                (rng.randrange(n), rng.randrange(n))
+                for _ in range(pair_samples)
+            ]
+            res = engine.evaluate_pairs(fc.cases, pairs)
+            for k, (si, di) in enumerate(pairs):
+                for qi in range(len(fc.cases)):
+                    got_p = tuple(bool(x) for x in res[k, qi])
+                    want_p = tuple(bool(x) for x in want[qi, si, di])
+                    if got_p != want_p:
+                        raise FuzzMismatch(
+                            f"seed {seed} (class_compress={mode}): "
+                            f"evaluate_pairs diverges at "
+                            f"case={fc.cases[qi]} src={fc.pods[si][:2]} "
+                            f"dst={fc.pods[di][:2]}: {got_p} != {want_p}"
+                        )
+                    pair_checks += 1
+    return {
+        "seed": seed,
+        "pods": n,
+        "tiered": fc.tiers is not None,
+        "cells": int(want.size // 3 * len(modes)),
+        "pair_checks": pair_checks,
+        "anp_count": 0 if fc.tiers is None else len(fc.tiers.anps),
+    }
+
+
+def run(
+    seeds: int = 8,
+    base_seed: int = 0,
+    *,
+    modes: Tuple[str, ...] = ("0", "1"),
+    check_counts: bool = True,
+    pair_samples: int = 16,
+    log=None,
+) -> FuzzReport:
+    """Run `seeds` consecutive seeds from `base_seed`; raises
+    FuzzMismatch on the first divergence."""
+    report = FuzzReport()
+    for s in range(base_seed, base_seed + seeds):
+        r = run_seed(
+            s, modes=modes, check_counts=check_counts, pair_samples=pair_samples
+        )
+        report.seeds.append(s)
+        report.cells_checked += r["cells"]
+        report.pair_checks += r["pair_checks"]
+        report.tiered_seeds += int(r["tiered"])
+        if log is not None:
+            log(
+                f"seed {s}: pods={r['pods']} anps={r['anp_count']} "
+                f"tiered={r['tiered']} cells={r['cells']} OK"
+            )
+    return report
+
+
+def run_conformance(log=None) -> int:
+    """Run the generator's ANP/BANP conformance family through the same
+    differential gate; returns the case count."""
+    from ..generator.anp_cases import tier_cases
+
+    n_cases = 0
+    for tc in tier_cases():
+        pods, namespaces = tc.cluster()
+        policy = build_network_policies(True, tc.netpols)
+        want = _oracle_table(policy, tc.tiers, pods, namespaces, tc.cases)
+        for mode in ("0", "1"):
+            engine = TpuPolicyEngine(
+                policy, pods, namespaces, tiers=tc.tiers, class_compress=mode
+            )
+            got = _engine_table(engine, tc.cases)
+            if not np.array_equal(got, want):
+                bad = np.argwhere(got != want)
+                qi, si, di, ki = (int(x) for x in bad[0])
+                raise FuzzMismatch(
+                    f"conformance case {tc.description!r} "
+                    f"(class_compress={mode}) diverges at "
+                    f"case={tc.cases[qi]} src={pods[si][:2]} "
+                    f"dst={pods[di][:2]} "
+                    f"component={('ingress', 'egress', 'combined')[ki]}"
+                )
+        n_cases += 1
+        if log is not None:
+            log(f"conformance: {tc.description} OK")
+    return n_cases

@@ -103,6 +103,15 @@ class Delta:
         "ns_labels",     # Namespace + Labels (full replacement)
         "policy_upsert", # Namespace/Name + Policy (NetworkPolicy dict)
         "policy_delete", # Namespace/Name
+        # precedence-tier objects (cyclonus_tpu/tiers): cluster-scoped,
+        # so Namespace stays empty; the k8s-shaped ANP/BANP dict rides
+        # the SAME optional Policy key — new kinds are data values, not
+        # new wire keys, so the envelope is unchanged and an old peer
+        # rejects them at validation, never at parse
+        "anp_upsert",    # Name + Policy (AdminNetworkPolicy dict)
+        "anp_delete",    # Name
+        "banp_upsert",   # Policy (BaselineAdminNetworkPolicy dict)
+        "banp_delete",   #
     )
 
     WIRE: ClassVar[Dict[str, contracts.WireField]] = {
@@ -115,7 +124,7 @@ class Delta:
     }
 
     kind: str
-    namespace: str
+    namespace: str = ""  # empty for the cluster-scoped tier kinds
     name: str = ""
     labels: Optional[Dict[str, str]] = None
     ip: Optional[str] = None

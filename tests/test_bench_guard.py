@@ -227,6 +227,14 @@ class TestBenchGuards:
         # BENCH_MEGA defaults to auto = TPU-only; on this CPU run the
         # block records as absent-by-default
         assert detail["mega_class"] is None
+        # the precedence-tier leg rides EVERY line (perfobs reads
+        # detail.tiers warn-only): a deterministic ANP/BANP lattice
+        # with oracle spot parity enforced inside the leg
+        tiers = detail["tiers"]
+        assert tiers["active"] is True
+        assert tiers["anp_count"] == 3 and tiers["banp"] is True
+        assert tiers["resolve_s"] > 0
+        assert tiers["parity_spot_checks"] >= 1
         # the telemetry block rides every BENCH line (and thus every
         # tunnel_wait round file): metrics incl. cache hit/miss counters
         # + HBM watermarks, span aggregates, and the flight window

@@ -35,7 +35,7 @@ from cyclonus_tpu.perfobs import report as perf_report  # noqa: E402
 
 def healthy_line(
     value=100e9, warmup=5.0, encode=1.0, mesh_rows=None, virtual=True,
-    serve=None,
+    serve=None, tiers=None,
 ):
     detail = {
         "build_s": 0.5,
@@ -77,6 +77,8 @@ def healthy_line(
         }
     if serve is not None:
         detail["serve"] = serve
+    if tiers is not None:
+        detail["tiers"] = tiers
     return {
         "metric": "simulated connectivity cells/sec (bench)",
         "value": value,
@@ -589,6 +591,74 @@ class TestServeFields:
         slow = healthy_line(value=120e9)
         base["detail"]["phase_history_s"].append(["serve_churn", 1.0])
         slow["detail"]["phase_history_s"].append(["serve_churn", 60.0])
+        led = self._ledger(
+            wrap(1, base), wrap(2, healthy_line()), wrap(3, slow),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+
+def tiers_detail(resolve_s=0.0002, anp_count=3, active=True):
+    return {
+        "active": active,
+        "anp_count": anp_count,
+        "rule_rows": 4,
+        "banp": True,
+        "resolve_s": resolve_s,
+        "pods": 1024,
+        "parity_spot_checks": 16,
+    }
+
+
+class TestTiersFields:
+    """detail.tiers rides every BENCH line; the ledger parses
+    active/anp_count/resolve_s and the sentinel treats resolve_s
+    WARN-ONLY (the tiers leg's own oracle spot-parity assertion is the
+    hard gate) — same discipline as the serve fields."""
+
+    def _ledger(self, *docs, tmp_path):
+        return load_ledger(write_rounds(tmp_path, list(docs)))
+
+    def test_ledger_parses_tiers_fields(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(tiers=tiers_detail())), tmp_path=tmp_path
+        )
+        run = led.runs[0]
+        assert run.tiers_active is True
+        assert run.tiers_anp_count == 3
+        assert run.tiers_resolve_s == 0.0002
+        # ledger round-trip keeps the fields
+        rt = PerfRun.from_dict(run.to_dict())
+        assert rt.tiers_resolve_s == run.tiers_resolve_s
+
+    def test_old_artifacts_without_tiers_parse(self, tmp_path):
+        led = self._ledger(wrap(1, healthy_line()), tmp_path=tmp_path)
+        run = led.runs[0]
+        assert run.tiers_active is False
+        assert run.tiers_resolve_s is None
+
+    def test_tiers_degradation_warns_never_fails(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(tiers=tiers_detail(resolve_s=0.0002))),
+            wrap(2, healthy_line(tiers=tiers_detail(resolve_s=0.0003))),
+            wrap(3, healthy_line(value=120e9,
+                                 tiers=tiers_detail(resolve_s=0.002))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        report = result.report()
+        assert "tiers_resolve_s degraded" in report
+        assert "warn, not fail" in report
+
+    def test_tiers_phase_not_generically_gated(self, tmp_path):
+        # a slow tiers phase must not trip the per-phase rule — the
+        # leg's knobs (BENCH_TIERS_*) legitimately vary per round
+        base = healthy_line()
+        slow = healthy_line(value=120e9)
+        base["detail"]["phase_history_s"].append(["tiers", 1.0])
+        slow["detail"]["phase_history_s"].append(["tiers", 60.0])
         led = self._ledger(
             wrap(1, base), wrap(2, healthy_line()), wrap(3, slow),
             tmp_path=tmp_path,

@@ -212,6 +212,42 @@ class TestWireCompatibility:
         assert Verdict.from_dict(verr.to_dict()) == verr
         assert "Epoch" not in verr.to_dict()
 
+    def test_tier_delta_kinds_ride_existing_keys(self):
+        """The precedence-tier delta kinds (anp_upsert/anp_delete/
+        banp_upsert/banp_delete) are data VALUES of the existing Kind
+        key — the k8s-shaped ANP/BANP dict rides the optional Policy
+        key and cluster-scoped objects leave Namespace empty, so the
+        wire envelope (and the golden above) is unchanged."""
+        from cyclonus_tpu.tiers.model import (
+            AdminNetworkPolicy,
+            TierRule,
+            TierScope,
+        )
+        from cyclonus_tpu.worker.model import Delta
+
+        a = AdminNetworkPolicy(
+            name="deny-all", priority=3, subject=TierScope(),
+            ingress=[TierRule(action="Deny", peers=[TierScope()])],
+        )
+        b = make_batch(0)
+        b.deltas = [
+            Delta(kind="anp_upsert", name="deny-all", policy=a.to_dict()),
+            Delta(kind="anp_delete", name="deny-all"),
+            Delta(kind="banp_upsert", policy={"kind": "x"}),
+            Delta(kind="banp_delete"),
+        ]
+        b2 = Batch.from_json(b.to_json())
+        assert b2 == b
+        # cluster-scoped: no NEW wire keys appear, Namespace serializes
+        # empty, unused optional payload keys are omitted per-delta
+        d = b.deltas[0].to_dict()
+        assert set(d) == {"Kind", "Namespace", "Name", "Policy"}
+        assert d["Namespace"] == ""
+        assert set(b.deltas[3].to_dict()) == {"Kind", "Namespace"}
+        # the payload survives the wire as a parseable ANP
+        rt = AdminNetworkPolicy.from_dict(b2.deltas[0].policy)
+        assert rt == a
+
     def test_serve_batch_ignored_by_old_worker(self):
         """Forward compat: a serve batch fed to the probe loop (an OLD
         worker that predates Deltas/Queries would parse the same way —
