@@ -58,11 +58,21 @@ parity-compressed:
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+# multichip smoke (docs/DESIGN.md "Multi-chip scale-out"): one
+# 8-virtual-device OVERLAPPED ring run — ring grid bit-identical to the
+# all-gather schedule and the single-device kernel, every collective
+# counts path verified, and the per-chip detail.mesh row emitted in the
+# schema the perfobs ledger ingests
+multichip-smoke:
+	JAX_PLATFORMS=cpu python -c \
+	  "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
-# smoke the verdict service, run the seeded tier fuzz gate, then run
-# the suite on a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed serve-smoke fuzz
+# smoke the verdict service and the 8-device overlapped mesh path, run
+# the seeded tier fuzz gate (mesh leg included), then run the suite on
+# a CPU 8-device mesh
+check: vet lint perf-gate parity-compressed serve-smoke multichip-smoke fuzz
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -73,7 +83,9 @@ conformance:
 # "Precedence tiers"): seeded adversarial ANP/BANP policy sets —
 # overlapping priorities, Pass-chains, overlapping CIDRs, empty
 # selectors, sentinel-adjacent ports, endPort ranges, SCTP — checked
-# kernel-vs-scalar-lattice-oracle, dense AND class-compressed, plus the
+# kernel-vs-scalar-lattice-oracle, dense AND class-compressed, every
+# engine's truth table ALSO routed through the overlapped ring mesh
+# path (the mesh leg; --no-mesh skips), plus the
 # generator's ANP/BANP conformance family.  Seeded and bounded (8
 # seeds) so it rides inside `make check`; a failure names the seed for
 # `cyclonus-tpu fuzz --seed N --seeds 1` reproduction.
@@ -109,4 +121,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench fmt vet lint shapelint perf-gate parity-compressed serve-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench fmt vet lint shapelint perf-gate parity-compressed serve-smoke multichip-smoke cyclonus docker

@@ -42,6 +42,13 @@ def setup_fuzz(sub) -> None:
         help="skip the tiled-counts cross-check",
     )
     p.add_argument(
+        "--no-mesh",
+        action="store_true",
+        help="skip the overlapped-mesh leg (each engine's truth table "
+        "re-evaluated through the ring-exchange sharded path on the "
+        "virtual multi-device mesh and pinned bit-identical)",
+    )
+    p.add_argument(
         "--pair-samples",
         type=int,
         default=16,
@@ -64,6 +71,20 @@ def setup_fuzz(sub) -> None:
 
 
 def _run_fuzz(args) -> int:
+    # the mesh leg is only a real multi-device differential when the
+    # CPU backend exposes a virtual mesh; force the device count BEFORE
+    # the first backend-touching jax call (XLA reads XLA_FLAGS at
+    # backend init — same pattern as bench.main / dryrun_multichip), so
+    # `cyclonus-tpu fuzz` exercises the ring exchange on 8 devices even
+    # when invoked outside the test harness (e.g. `make fuzz`)
+    import os
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
     from ..tiers import fuzz
 
     t0 = time.perf_counter()
@@ -74,6 +95,7 @@ def _run_fuzz(args) -> int:
             base_seed=args.seed,
             modes=("0",) if args.dense_only else ("0", "1"),
             check_counts=not args.no_counts,
+            check_mesh=not args.no_mesh,
             pair_samples=args.pair_samples,
             log=log,
         )
@@ -97,7 +119,8 @@ def _run_fuzz(args) -> int:
         print(
             f"fuzz gate green: {len(out['seeds'])} seeds "
             f"({out['tiered_seeds']} tiered), {out['cells_checked']} "
-            f"truth-table cells, {out['pair_checks']} pair checks"
+            f"truth-table cells ({out['mesh_cells_checked']} re-checked "
+            f"via the overlapped mesh), {out['pair_checks']} pair checks"
             + (
                 f", {conformance} conformance cases"
                 if conformance is not None

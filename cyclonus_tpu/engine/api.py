@@ -381,6 +381,31 @@ def _bucket_dim(n: int, lo: int = 4) -> int:
     return -(-n // 128) * 128
 
 
+def _bucket_up(n: int, steps: int) -> int:
+    """`n` (already a _bucket_dim bucket) stepped UP `steps` buckets —
+    the slab-headroom pre-reservation (serve engines reserve one extra
+    bucket so bucket-crossing policy churn stays on the incremental
+    patch path instead of forcing a full rebuild)."""
+    for _ in range(max(0, steps)):
+        n = _bucket_dim(n + 1)
+    return n
+
+
+def _bucket_down(n: int, steps: int) -> int:
+    """Inverse of _bucket_up on the bucket ladder (4..128 pow2, then
+    multiples of 128), floored at the smallest bucket.  Used to recover
+    a slab's ZERO-HEADROOM bucket from its allocated (headroom-stepped)
+    size when counting headroom saves."""
+    for _ in range(max(0, steps)):
+        if n > 256:
+            n -= 128
+        elif n == 256:
+            n = 128
+        else:
+            n = max(4, n // 2)
+    return n
+
+
 def _bucket_pods(n: int) -> int:
     """Pod-axis bucket: pow2 up to 1024, then multiples of 1024 (matches
     the tile block, and keeps large-N padding waste under ~0.1%)."""
@@ -404,6 +429,12 @@ def _pad_axis(a: np.ndarray, axis: int, size: int, fill) -> np.ndarray:
 # encoding.py's padding-neutrality invariants: padded selectors are
 # unreferenced, padded targets match no pod (ns -1), padded peers belong
 # to target -1 (zero one-hot row), padded port items/ranges match nothing
+_SEL_PADS = {
+    "sel_req_kv": -1,
+    "sel_exp_op": 0,
+    "sel_exp_key": -1,
+    "sel_exp_vals": -1,
+}
 _DIRECTION_PADS = {
     "target_ns": -1,
     "target_sel": 0,
@@ -449,36 +480,38 @@ _TIER_PADS = {
 }
 
 
-def _bucket_tensors(tensors: Dict) -> Dict:
+def _bucket_tensors(tensors: Dict, headroom: int = 0) -> Dict:
     """Pad every tensor dimension up to its shape bucket with the inert
     fill for that array, so near-identical problems share compiled
     programs.  Semantics are unchanged by construction: each pad value is
     the same inert encoding the encoder itself uses for ragged padding
-    (verified by the parity suites, which run everything bucketed)."""
+    (verified by the parity suites, which run everything bucketed).
+
+    `headroom` steps the RULE-SLAB row buckets (selector table, target/
+    peer axes, tier rule rows) up that many extra buckets — the serve
+    path's slab pre-reservation (CYCLONUS_SERVE_HEADROOM): the reserved
+    rows are the same inert pads, so verdicts are unchanged, and a
+    later policy patch that crosses the natural bucket boundary can pad
+    into the reservation instead of changing compiled shapes."""
     from .sharded import _pad_pod_arrays
 
     t = dict(tensors)
-    # selector tables: rows are unreferenced when padded
-    s = _bucket_dim(t["sel_req_kv"].shape[0])
-    t["sel_req_kv"] = _pad_axis(
-        _pad_axis(t["sel_req_kv"], 1, _bucket_dim(t["sel_req_kv"].shape[1]), -1),
-        0, s, -1,
-    )
-    t["sel_exp_op"] = _pad_axis(
-        _pad_axis(t["sel_exp_op"], 1, _bucket_dim(t["sel_exp_op"].shape[1]), 0),
-        0, s, 0,
-    )
-    t["sel_exp_key"] = _pad_axis(
-        _pad_axis(t["sel_exp_key"], 1, _bucket_dim(t["sel_exp_key"].shape[1]), -1),
-        0, s, -1,
-    )
+    # selector tables: rows are unreferenced when padded (fills from
+    # _SEL_PADS — the one table this and the serve patch path share)
+    s = _bucket_up(_bucket_dim(t["sel_req_kv"].shape[0]), headroom)
+    for k in ("sel_req_kv", "sel_exp_op", "sel_exp_key"):
+        fill = _SEL_PADS[k]
+        t[k] = _pad_axis(
+            _pad_axis(t[k], 1, _bucket_dim(t[k].shape[1]), fill), 0, s, fill
+        )
     ev = t["sel_exp_vals"]
+    fill = _SEL_PADS["sel_exp_vals"]
     t["sel_exp_vals"] = _pad_axis(
         _pad_axis(
-            _pad_axis(ev, 2, _bucket_dim(ev.shape[2]), -1),
-            1, _bucket_dim(ev.shape[1]), -1,
+            _pad_axis(ev, 2, _bucket_dim(ev.shape[2]), fill),
+            1, _bucket_dim(ev.shape[1]), fill,
         ),
-        0, s, -1,
+        0, s, fill,
     )
     # namespace tables: padded rows are unreferenced (ns ids are real)
     m = _bucket_dim(t["ns_kv"].shape[0])
@@ -496,8 +529,8 @@ def _bucket_tensors(tensors: Dict) -> Dict:
         # (pallas_kernel._augment): bucket to boundary - 1 so the
         # augmented axis lands exactly on the 128 chunk boundary instead
         # of spilling a whole extra chunk into the contraction
-        nt = _bucket_dim(d["target_ns"].shape[0] + 1) - 1
-        np_ = _bucket_dim(d["peer_kind"].shape[0])
+        nt = _bucket_up(_bucket_dim(d["target_ns"].shape[0] + 1), headroom) - 1
+        np_ = _bucket_up(_bucket_dim(d["peer_kind"].shape[0]), headroom)
         for k, fill in _DIRECTION_PADS.items():
             if k not in d:
                 continue
@@ -519,7 +552,7 @@ def _bucket_tensors(tensors: Dict) -> Dict:
         tiers = {}
         for direction in ("ingress", "egress"):
             d = dict(t["tiers"][direction])
-            g = _bucket_dim(d["action"].shape[0])
+            g = _bucket_up(_bucket_dim(d["action"].shape[0]), headroom)
             for k, fill in _TIER_PADS.items():
                 d[k] = _pad_axis(d[k], 0, g, fill)
             spec = {}
@@ -741,6 +774,7 @@ class TpuPolicyEngine:
         compact: Optional[bool] = None,
         class_compress: Optional[str] = None,
         tiers=None,
+        slab_headroom: int = 0,
     ):
         # compact/class_compress override the CYCLONUS_COMPACT /
         # CYCLONUS_CLASS_COMPRESS env defaults per engine (None = env).
@@ -760,6 +794,12 @@ class TpuPolicyEngine:
         ensure_persistent_compile_cache()
         self._opt_compact = compact
         self._opt_class_compress = class_compress
+        # rule-slab headroom (extra _bucket_dim steps pre-reserved on
+        # the selector/target/peer/tier row buckets).  0 for batch
+        # engines; the serve path passes CYCLONUS_SERVE_HEADROOM so
+        # bucket-crossing policy churn patches into the reservation
+        # (serve/incremental.py patch_policy) instead of rebuilding.
+        self._slab_headroom = max(0, int(slab_headroom or 0))
         self.tiers = tiers if tiers else None
         if self.tiers is not None:
             self.tiers.validate()
@@ -809,11 +849,15 @@ class TpuPolicyEngine:
                         self._tensors[direction] = nd
                     self._partition_stats = pstats
                 self._maybe_build_class_state(mode)
-            self._tensors = _bucket_tensors(_sort_targets_by_ns(self._tensors))
+            self._tensors = _bucket_tensors(
+                _sort_targets_by_ns(self._tensors),
+                headroom=self._slab_headroom,
+            )
             if self._class_state is not None:
                 st = self._class_state
                 st["ctensors"] = _bucket_tensors(
-                    _sort_targets_by_ns(st.pop("ctensors_raw"))
+                    _sort_targets_by_ns(st.pop("ctensors_raw")),
+                    headroom=self._slab_headroom,
                 )
                 # the gather/index tensors the compressed path pins on
                 # device: class map + weights + the compressed tensor
@@ -1205,11 +1249,12 @@ class TpuPolicyEngine:
         )
 
     def _evaluate_grid_sharded_classes(
-        self, cases: Sequence[PortCase], mesh
+        self, cases: Sequence[PortCase], mesh, schedule=None
     ) -> GridVerdict:
         """Compressed mesh path: the shard_map program runs over the
-        class axis; the gather epilogue broadcasts back to pod axes
-        device-side (sharded.evaluate_class_grid_sharded)."""
+        class axis — with the ring schedule, a C x C ring over class
+        representatives; the gather epilogue broadcasts back to pod
+        axes device-side (sharded.evaluate_class_grid_sharded)."""
         import jax.numpy as jnp
 
         from .sharded import evaluate_class_grid_sharded
@@ -1219,7 +1264,8 @@ class TpuPolicyEngine:
         tensors = self._ctensors_with_cases(cases)
         with phase("engine.dispatch_sharded"):
             ingress, egress, combined = evaluate_class_grid_sharded(
-                tensors, pc.n_classes, pc.class_of_pod, mesh=mesh
+                tensors, pc.n_classes, pc.class_of_pod, mesh=mesh,
+                schedule=schedule,
             )
         ti.CLASS_EVALS.inc(path="sharded")
         return GridVerdict(
@@ -2156,6 +2202,32 @@ class TpuPolicyEngine:
             self._tensors_with_cases(cases), n, block=block, mesh=mesh
         )
 
+    def mesh_counts_pipelined_eval_s(
+        self,
+        cases: Sequence[PortCase],
+        reps: int = 10,
+        block: int = 1024,
+        mesh=None,
+    ):
+        """Steady-state DEVICE-side seconds per MESH counts evaluation —
+        counts_pipelined_eval_s's twin for the overlapped ring path:
+        one seed dispatch pins the sharded tensors + per-shard
+        precompute on the mesh, then `reps` ring sweeps run back to
+        back with the rotating peer bundle DONATED and fed forward
+        (engine/tiled.py ring_counts_pipeline), one readback at the
+        end.  Returns (seconds_per_eval, counts), or None for an empty
+        problem."""
+        self._check_ips()
+        n = self.encoding.cluster.n_pods
+        if not cases or n == 0:
+            return None
+        from .tiled import evaluate_grid_counts_ring_pipelined
+
+        return evaluate_grid_counts_ring_pipelined(
+            self._tensors_with_cases(cases), n, reps=reps, block=block,
+            mesh=mesh,
+        )
+
     def evaluate_grid_counts_ring2d(
         self, cases: Sequence[PortCase], block: int = 1024, mesh=None
     ) -> Dict[str, int]:
@@ -2253,26 +2325,32 @@ class TpuPolicyEngine:
         return out
 
     def evaluate_grid_sharded(
-        self, cases: Sequence[PortCase], mesh=None
+        self, cases: Sequence[PortCase], mesh=None, schedule=None
     ) -> GridVerdict:
         """Mesh-sharded evaluation: the shard_map program runs over `mesh`
         (default: all devices of the default backend, or the virtual CPU
         mesh when the default backend is a single chip — see
-        sharded.default_mesh).  A 1-device mesh still runs the sharded
-        program; use evaluate_grid for the plain single-device kernel."""
+        sharded.default_mesh).  `schedule` picks the peer exchange:
+        "ring" (overlapped ppermute streaming, the default) or
+        "allgather" (the replicated reference) — bit-identical grids
+        either way.  A 1-device mesh still runs the sharded program;
+        use evaluate_grid for the plain single-device kernel."""
         from .sharded import evaluate_grid_sharded
 
         self._check_ips()
         if not cases:
             return self.evaluate_grid(cases)
         if self._class_state is not None:
-            return self._evaluate_grid_sharded_classes(cases, mesh)
+            return self._evaluate_grid_sharded_classes(
+                cases, mesh, schedule=schedule
+            )
         tensors = self._tensors_with_cases(cases)
         import jax.numpy as jnp
 
         with phase("engine.dispatch_sharded"):
             ingress, egress, combined = evaluate_grid_sharded(
-                tensors, self.encoding.cluster.n_pods, mesh=mesh
+                tensors, self.encoding.cluster.n_pods, mesh=mesh,
+                schedule=schedule,
             )
         return GridVerdict(
             self.pod_keys,

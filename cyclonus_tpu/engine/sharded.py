@@ -4,21 +4,38 @@ Sharding layout (see SURVEY.md section 2.7 / 5):
   * every per-pod tensor (labels, ns ids, IPs) is sharded over the 1D mesh
     axis 'x'; policy tensors (selectors, targets, peers, port specs) are
     replicated — they are small.
-  * each device computes verdict ROWS for its source-pod block:
-      - egress: target side is the (local) source block; the peer-side
-        target_allows[T, N, Q] is ALL-GATHERed (one collective per eval).
-      - ingress: peer side is the (local) source block; the target-side
-        tmatch[T, N] + has_target[N] are ALL-GATHERed (port-independent).
+  * each device computes verdict ROWS for its source-pod block.
   * output [N_src, N_dst, Q] stays row-sharded until fetched.
 
-The collectives ride ICI on a real TPU slice; on CPU the same program runs
-over the virtual 8-device mesh (tests/conftest.py) and in dryrun_multichip.
+Two schedules produce bit-identical grids (docs/DESIGN.md "Multi-chip
+scale-out"):
+
+  ring (default) — the OVERLAPPED path: each device keeps only its own
+      pod shard's peer-side precompute and streams peer pod-blocks
+      around the mesh with jax.lax.ppermute, one hop per step, computing
+      the verdict block it already holds while the next block is in
+      flight (the ppermute is issued BEFORE the step's matmuls, so the
+      ICI transfer hides behind the MXU work).  Per-device peer-side
+      working set: O(N / n_dev) resident + one in-flight block, vs the
+      all-gather schedule's O(N) replicated copy.
+
+  allgather — the reference schedule the ring is differentially pinned
+      against: the peer-side target_allows[T, N, Q] (egress) and
+      tmatch[T, N] + has_target[N] (ingress) are ALL-GATHERed once per
+      eval and every device contracts against the full replicated copy.
+
+The collectives ride ICI on a real TPU slice; on CPU the same programs
+run over the virtual 8-device mesh (tests/conftest.py) and in
+dryrun_multichip.  Compiled programs are cached per (mesh, schedule,
+shard) so repeat evaluations — and same-bucket cluster resizes — reuse
+the trace (the zero-recompile elastic-resize contract).
 """
 
 from __future__ import annotations
 
 import inspect
 import math
+import os
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -169,8 +186,11 @@ def _pad_pod_arrays(tensors: Dict, n_pods: int, n_dev: int) -> Tuple[Dict, int]:
 
 
 def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """The per-device program.  Local pod block = this device's source rows
-    (and, symmetrically, its slice of every per-pod precompute)."""
+    """The per-device ALL-GATHER reference program (schedule="allgather").
+    Local pod block = this device's source rows (and, symmetrically, its
+    slice of every per-pod precompute); the peer side is gathered whole.
+    Kept as the differential twin the overlapped ring schedule is pinned
+    bit-identical against."""
     selpod = selector_match(
         tensors["sel_req_kv"],
         tensors["sel_exp_op"],
@@ -296,23 +316,156 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
     return ingress_rows, egress, combined
 
 
+def _ring_grid_eval(tensors: Dict, n_dev: int, shard: int):
+    """The per-device OVERLAPPED ring program: local peer-side bundle
+    only, one ppermute hop per step, verdict blocks written column-wise.
+
+    Reuses the tiled path's precompute/split/verdict bodies
+    (tiled._precompute / _split_pre / _tile_verdicts_split) so the ring
+    step's semantics — including the precedence-tier epilogue, whose
+    min-key resolution runs INSIDE each ring step against the rotated
+    subject/peer blocks — can never diverge from the single-device and
+    ring-counts paths."""
+    from .tiled import (
+        _dst_bundle_keys,
+        _precompute,
+        _ring_sweep,
+        _split_pre,
+        _tile_verdicts_split,
+    )
+
+    pre = _precompute(tensors)
+    src, dst0 = _split_pre(pre)
+    dev = jax.lax.axis_index("x")
+    n_total = n_dev * shard
+    q = tensors["q_port"].shape[0]
+    init = tuple(
+        jnp.zeros((shard, n_total, q), dtype=bool) for _ in range(3)
+    )
+
+    def body(step, ring, grids):
+        ing, eg, comb = grids
+        dst = {k: ring[k] for k in _dst_bundle_keys(ring)}
+        i_blk, e_blk, c_blk = _tile_verdicts_split(src, dst, 0, shard)
+        # after `step` hops we hold the bundle that originated at device
+        # (dev - step) mod n_dev: its verdicts land in those columns
+        col0 = ((dev - step) % n_dev) * shard
+        ing = jax.lax.dynamic_update_slice(ing, i_blk, (0, col0, 0))
+        eg = jax.lax.dynamic_update_slice(eg, e_blk, (0, col0, 0))
+        comb = jax.lax.dynamic_update_slice(comb, c_blk, (0, col0, 0))
+        return (ing, eg, comb)
+
+    (ing, eg, comb), _ = _ring_sweep(n_dev, dst0, init, body)
+    return ing, eg, comb
+
+
+def mesh_schedule(schedule: Optional[str] = None) -> str:
+    """Resolve the mesh exchange schedule: explicit arg, else
+    CYCLONUS_MESH_SCHEDULE, else "ring" (the overlapped default;
+    "allgather" keeps the replicated reference schedule)."""
+    s = (schedule or os.environ.get("CYCLONUS_MESH_SCHEDULE", "ring")).lower()
+    if s not in ("ring", "allgather"):
+        raise ValueError(
+            f"unknown mesh schedule {s!r} (want 'ring' or 'allgather')"
+        )
+    return s
+
+
+def peer_buffer_bytes(
+    tensors: Dict, n_dev: int, schedule: str
+) -> int:
+    """Host-side estimate of the PER-DEVICE peer-side working set of one
+    sharded grid eval — the number the HBM watermark gauge records and
+    the scale-out acceptance asserts on (ring < allgather at 8 devices).
+
+    allgather: the gathered bool arrays every device holds replicated —
+    egress tallow [T_e, N, Q] + ingress tmatch [T_i, N] + has [N]
+    (+ the gathered tier scope blocks).  ring: TWO copies (resident +
+    in-flight ppermute target) of the rotating bundle over one shard —
+    tallow_bf is bf16 (2 bytes), the rest bool."""
+    n = int(tensors["pod_ns_id"].shape[0])
+    q = int(tensors["q_port"].shape[0])
+    t_e = int(tensors["egress"]["target_ns"].shape[0])
+    t_i = int(tensors["ingress"]["target_ns"].shape[0])
+    g_e = g_i = 0
+    if "tiers" in tensors:
+        g_e = int(tensors["tiers"]["egress"]["action"].shape[0])
+        g_i = int(tensors["tiers"]["ingress"]["action"].shape[0])
+    if schedule == "allgather":
+        return t_e * n * q + t_i * n + n + g_e * n * q + g_i * n
+    shard = n // max(n_dev, 1)
+    bundle = (
+        2 * t_e * shard * q  # tallow_bf: bf16
+        + t_i * shard
+        + shard  # has_i
+        + g_e * shard * q
+        + g_i * shard
+    )
+    return 2 * bundle
+
+
+#: compiled sharded-grid programs, keyed by (mesh devices, schedule,
+#: shard, in_specs structure).  One entry per (mesh, schedule, shape
+#: family) — re-jitting per eval cost a full retrace every call, and a
+#: same-bucket cluster resize must hit this cache (zero-recompile
+#: contract, pinned by tests/test_engine_sharded.py)
+_SHARDED_PROGRAMS: Dict = {}
+_SHARDED_PROGRAMS_MAX = 64
+
+
+def _sharded_program(mesh: Mesh, schedule: str, shard: int, in_specs: Dict):
+    n_dev = int(mesh.devices.size)
+    leaves, treedef = jax.tree_util.tree_flatten(in_specs)
+    key = (
+        tuple(mesh.devices.flat),
+        tuple(mesh.axis_names),
+        schedule,
+        shard,
+        treedef,
+        tuple(leaves),
+    )
+    fn = _SHARDED_PROGRAMS.get(key)
+    if fn is None:
+        out_specs = (
+            P("x", None, None),
+            P("x", None, None),
+            P("x", None, None),
+        )
+        if schedule == "ring":
+            def body(t, _n_dev=n_dev, _shard=shard):
+                return _ring_grid_eval(t, _n_dev, _shard)
+        else:
+            body = _sharded_eval
+        fn = jax.jit(
+            shard_map_no_check(
+                body, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
+            )
+        )
+        if len(_SHARDED_PROGRAMS) >= _SHARDED_PROGRAMS_MAX:
+            _SHARDED_PROGRAMS.clear()  # crude bound; programs re-jit
+        _SHARDED_PROGRAMS[key] = fn
+    return fn
+
+
 def evaluate_class_grid_sharded(
     tensors: Dict,
     n_classes: int,
     class_of: np.ndarray,
     mesh: Optional[Mesh] = None,
+    schedule: Optional[str] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Mesh-sharded evaluation over the COMPRESSED class grid + the
     int32 gather epilogue back to pod axes.
 
     `tensors` carries class-representative rows on the pod axis
     (encoding.gather_class_pod_rows); the shard_map program is exactly
-    evaluate_grid_sharded over that axis, and the broadcast back to the
-    full pod x pod grid is two chained jnp.take gathers per verdict
-    tensor — device-resident, lazy, identical in layout to the dense
-    path's outputs."""
+    evaluate_grid_sharded over that axis — with the ring schedule this
+    is the C x C ring over class representatives — and the broadcast
+    back to the full pod x pod grid is two chained jnp.take gathers per
+    verdict tensor — device-resident, lazy, identical in layout to the
+    dense path's outputs."""
     ingress, egress, combined = evaluate_grid_sharded(
-        tensors, n_classes, mesh=mesh
+        tensors, n_classes, mesh=mesh, schedule=schedule
     )
 
     def g(a):
@@ -323,31 +476,31 @@ def evaluate_class_grid_sharded(
 
 
 def evaluate_grid_sharded(
-    tensors: Dict, n_pods: int, mesh: Optional[Mesh] = None
+    tensors: Dict,
+    n_pods: int,
+    mesh: Optional[Mesh] = None,
+    schedule: Optional[str] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Returns (ingress[N_dst, N_src, Q], egress[N_src, N_dst, Q],
     combined[N_src, N_dst, Q]) as DEVICE-RESIDENT (immutable) jax arrays,
-    pad rows stripped lazily."""
+    pad rows stripped lazily.  `schedule` picks the peer exchange:
+    "ring" (overlapped, default) or "allgather" (replicated reference);
+    both are bit-identical by construction and pinned so by
+    tests/test_engine_sharded.py."""
     mesh = mesh or default_mesh()
+    schedule = mesh_schedule(schedule)
     n_dev = mesh.devices.size
-    tensors, _padded_n = _pad_pod_arrays(tensors, n_pods, n_dev)
+    tensors, padded_n = _pad_pod_arrays(tensors, n_pods, n_dev)
+    shard = padded_n // n_dev
 
     in_specs = pod_sharded_in_specs(tensors)
-
-    out_specs = (
-        P("x", None, None),
-        P("x", None, None),
-        P("x", None, None),
-    )
-
-    fn = jax.jit(
-        shard_map_no_check(
-            _sharded_eval, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
-        )
+    fn = _sharded_program(mesh, schedule, shard, in_specs)
+    ti.MESH_PEER_BYTES.set(
+        peer_buffer_bytes(tensors, n_dev, schedule), schedule=schedule
     )
     with ti.eval_flight(
         "grid.sharded", n_pods, int(tensors["q_port"].shape[0]),
-        devices=int(n_dev), dispatch_only=True,
+        devices=int(n_dev), schedule=schedule, dispatch_only=True,
     ):
         with mesh_device_context(mesh):
             ingress_rows, egress, combined = fn(tensors)

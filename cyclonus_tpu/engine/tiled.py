@@ -48,6 +48,7 @@ from typing import Dict, Iterator, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..telemetry import instruments as ti
 from ..utils.tracing import phase
@@ -159,6 +160,33 @@ def _dst_bundle_keys(ring: Dict) -> Tuple[str, ...]:
     if "tier_peerq_e" in ring:
         keys = keys + _DST_TIER_KEYS
     return keys
+
+
+def _ring_sweep(n_dev: int, ring: Dict, init, body):
+    """THE double-buffered ring loop every 1-D ring path shares — the
+    sync ring counts, the pipelined twin, and the sharded grid ring
+    (sharded._ring_grid_eval) — so the schedule can never diverge
+    between them.  One ppermute hop per step, ISSUED BEFORE the step's
+    compute: the transfer and the compute both only read the current
+    bundle, so the hop flies on ICI while the MXU contracts (one
+    resident bundle + one in-flight).  `body(step, ring, acc) -> acc`
+    consumes the bundle currently held.  All n_dev hops run — the final
+    rotation returns every bundle to its origin; it is kept rather than
+    guarded out because collectives under lax.cond don't lower
+    reliably, it is one ICI transfer, and the pipelined twin RELIES on
+    it to hand the bundle back for the next eval's donation.  Returns
+    (acc, ring-at-origin)."""
+    perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+
+    def ring_step(step, carry):
+        acc, ring = carry
+        nxt = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, "x", perm), ring
+        )
+        acc = body(step, ring, acc)
+        return acc, nxt
+
+    return jax.lax.fori_loop(0, n_dev, ring_step, (init, ring))
 
 
 def _split_pre(pre: Dict) -> Tuple[Dict, Dict]:
@@ -692,8 +720,7 @@ def evaluate_grid_counts_ring(
         src, dst0 = _split_pre(pre)
         ring = dict(dst0, valid=valid_local)
 
-        def ring_step(step, carry):
-            counts, ring = carry
+        def body(step, ring, counts):
             dst = {k: ring[k] for k in _dst_bundle_keys(ring)}
 
             def tile(i, counts):
@@ -702,25 +729,207 @@ def evaluate_grid_counts_ring(
                 )
                 return counts.at[step * tiles_per_shard + i].set(row)
 
-            counts = jax.lax.fori_loop(0, tiles_per_shard, tile, counts)
-            # rotate the dst bundle one hop around the ring.  The final
-            # rotation (returning every bundle to its origin) is kept
-            # rather than guarded out: collectives under lax.cond don't
-            # lower reliably, and the extra hop is one ICI transfer.
-            perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
-            ring = jax.tree_util.tree_map(
-                lambda x: jax.lax.ppermute(x, "x", perm), ring
-            )
-            return counts, ring
+            return jax.lax.fori_loop(0, tiles_per_shard, tile, counts)
 
         counts = jnp.zeros((n_dev * tiles_per_shard, 3), dtype=jnp.int32)
-        counts, _ = jax.lax.fori_loop(0, n_dev, ring_step, (counts, ring))
+        counts, _ = _ring_sweep(n_dev, ring, counts, body)
         return jax.lax.all_gather(counts, "x", axis=0, tiled=True)
 
     return _run_mesh_counts(
         per_device, mesh, pod_sharded_in_specs(tensors), tensors, q, n_pods,
         path="counts.ring",
     )
+
+
+# --- double-buffered pipelined ring counts --------------------------------
+#
+# The sync ring path re-transfers the host tensors and re-derives the
+# peer-side bundle every eval; at N chips the per-dispatch overhead is
+# what the single-chip pipelined path already amortizes away (BENCH_r05:
+# dispatch_overhead_s 0.09).  This twin splits the program in two:
+#
+#   seed(tensors) -> (src, ring)   one host->device transfer + the
+#                                  per-shard precompute, device-resident
+#   step(src, ring) -> (partials, ring)   the full n_dev-hop ring sweep;
+#                                  the `ring` argument is DONATED, and
+#                                  the final hop returns every bundle to
+#                                  its origin, so the output ring aliases
+#                                  the input's buffers — the rotating
+#                                  peer slabs stream in place, no fresh
+#                                  HBM per eval
+#
+# so steady-state mesh evals dispatch only `step`, back to back, with one
+# readback (counts_pipelined_eval_s's discipline, on the mesh).
+
+#: shard_map specs of the src-side (local, non-rotating) precompute view
+_SRC_SPECS = {
+    "tmatch_e": P(None, "x"),  # shape: (T_e, N) bool
+    "has_e": P("x"),  # shape: (N,) bool
+    "tallow_i": P(None, "x", None),  # shape: (T_i, N, Q) bf16
+    "tier_subj_e": P(None, "x"),  # shape: (G_e, N) bool
+    "tier_peerq_i": P(None, "x", None),  # shape: (G_i, N, Q) bool
+    "tier_keys_e": P(),  # shape: (2, G_e) int32 (replicated)
+    "tier_keys_i": P(),  # shape: (2, G_i) int32 (replicated)
+}
+#: shard_map specs of the rotating peer-side ring bundle (the arrays a
+#: ppermute hop moves; donated by the step program)
+_RING_SPECS = {
+    "tallow_e": P(None, "x", None),  # shape: (T_e, N, Q) bf16
+    "tmatch_i": P(None, "x"),  # shape: (T_i, N) bool
+    "has_i": P("x"),  # shape: (N,) bool
+    "tier_peerq_e": P(None, "x", None),  # shape: (G_e, N, Q) bool
+    "tier_subj_i": P(None, "x"),  # shape: (G_i, N) bool
+    "valid": P("x"),  # shape: (N,) bool
+}
+
+_RING_PIPELINES: Dict = {}
+_RING_PIPELINES_MAX = 32
+
+
+def ring_counts_pipeline(tensors: Dict, n_pods: int, block: int, mesh):
+    """(mesh, seed_fn, step_fn, meta) for the double-buffered ring
+    counts pipeline over `tensors` (already padded by the caller via
+    _mesh_counts_setup).  Programs are cached per (mesh, shapes,
+    tiered) so repeat case sets and same-bucket resizes reuse the
+    compiled pair."""
+    from .sharded import pod_sharded_in_specs, shard_map_no_check
+
+    n_dev = int(mesh.devices.size)
+    n_padded = int(tensors["pod_ns_id"].shape[0])
+    shard = n_padded // n_dev
+    tiles_per_shard = shard // block
+    tiered = "tiers" in tensors
+    in_specs = pod_sharded_in_specs(tensors)
+    leaves, treedef = jax.tree_util.tree_flatten(in_specs)
+    key = (
+        tuple(mesh.devices.flat),
+        tuple(mesh.axis_names),
+        shard,
+        block,
+        n_pods,
+        tiered,
+        treedef,
+        tuple(leaves),
+    )
+    cached = _RING_PIPELINES.get(key)
+    if cached is not None:
+        return cached
+
+    def seed_device(t):
+        pre = _precompute(t)
+        src, dst0 = _split_pre(pre)
+        dev = jax.lax.axis_index("x")
+        valid = (jnp.arange(shard) + dev * shard) < n_pods
+        return src, dict(dst0, valid=valid)
+
+    def step_device(src, ring):
+        dev = jax.lax.axis_index("x")
+        valid_local = (jnp.arange(shard) + dev * shard) < n_pods
+
+        def body(step, ring, counts):
+            dst = {k: ring[k] for k in _dst_bundle_keys(ring)}
+
+            def tile(i, counts):
+                row = _tile_counts_split(
+                    src, dst, valid_local, ring["valid"], i * block, block
+                )
+                return counts.at[step * tiles_per_shard + i].set(row)
+
+            return jax.lax.fori_loop(0, tiles_per_shard, tile, counts)
+
+        counts = jnp.zeros((n_dev * tiles_per_shard, 3), dtype=jnp.int32)
+        # the sweep's final hop returns every bundle to its origin,
+        # which is what lets the caller feed the returned ring straight
+        # back into the next (donated) step dispatch
+        counts, ring = _ring_sweep(n_dev, ring, counts, body)
+        return (
+            jax.lax.all_gather(counts, "x", axis=0, tiled=True),
+            ring,
+        )
+
+    src_specs = {
+        k: v for k, v in _SRC_SPECS.items() if tiered or not k.startswith("tier")
+    }
+    ring_specs = {
+        k: v
+        for k, v in _RING_SPECS.items()
+        if tiered or not k.startswith("tier")
+    }
+    seed_fn = jax.jit(
+        shard_map_no_check(
+            seed_device,
+            mesh=mesh,
+            in_specs=(in_specs,),
+            out_specs=(src_specs, ring_specs),
+        )
+    )
+    step_fn = jax.jit(
+        shard_map_no_check(
+            step_device,
+            mesh=mesh,
+            in_specs=(src_specs, ring_specs),
+            out_specs=(P(), ring_specs),
+        ),
+        # the rotating peer buffers are DONATED: the returned (origin-
+        # restored) bundle reuses their storage, so back-to-back step
+        # dispatches stream the peer slabs through one double-buffered
+        # allocation instead of allocating a bundle per eval
+        donate_argnums=(1,),
+    )
+    out = (seed_fn, step_fn, {"shard": shard, "tiles": tiles_per_shard})
+    if len(_RING_PIPELINES) >= _RING_PIPELINES_MAX:
+        _RING_PIPELINES.clear()
+    _RING_PIPELINES[key] = out
+    return out
+
+
+def evaluate_grid_counts_ring_pipelined(
+    tensors: Dict,
+    n_pods: int,
+    reps: int = 10,
+    block: int = 1024,
+    mesh=None,
+) -> Tuple[float, Dict[str, int]]:
+    """Steady-state DEVICE-side seconds per ring-counts evaluation: one
+    seed (transfer + precompute), then `reps` back-to-back step
+    dispatches — the rotating peer bundle donated and fed forward — with
+    ONE readback at the end, so per-eval cost excludes the per-dispatch
+    host round trip (counts_pipelined_eval_s's discipline, on the mesh).
+    Returns (seconds_per_eval, counts)."""
+    import time as _time
+
+    from .sharded import mesh_device_context
+
+    mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
+        tensors, n_pods, block, mesh
+    )
+    seed_fn, step_fn, _meta = ring_counts_pipeline(
+        tensors, n_pods, block, mesh
+    )
+    with ti.eval_flight(
+        "counts.ring.pipelined", n_pods, q, devices=int(n_dev), reps=reps
+    ) as fl:
+        with mesh_device_context(mesh):
+            src, ring = seed_fn(tensors)
+            partials, ring = step_fn(src, ring)  # warm: compile + run
+            np.asarray(partials)
+            t0 = _time.perf_counter()
+            for _ in range(max(reps, 1)):
+                partials, ring = step_fn(src, ring)
+            counts_np = np.asarray(partials)  # in-order stream: one barrier
+            dt = (_time.perf_counter() - t0) / max(reps, 1)
+        totals = counts_np.astype(np.int64).sum(axis=0)
+        cells = q * n_pods * n_pods
+        fl.set(cells=cells)
+    counts = {
+        "ingress": int(totals[0]),
+        "egress": int(totals[1]),
+        "combined": int(totals[2]),
+        "cells": cells,
+    }
+    if dt > 0:
+        ti.MESH_RING_STEP_SECONDS.set(dt / max(n_dev, 1))
+    return dt, counts
 
 
 def evaluate_grid_counts_ring2d(

@@ -176,7 +176,10 @@ def _bench_run_from_parsed(
             run.tiers_anp_count = int(tiers["anp_count"])
         if isinstance(tiers.get("resolve_s"), (int, float)):
             run.tiers_resolve_s = float(tiers["resolve_s"])
-    mesh = detail.get("mesh_scaling") or {}
+    # detail.mesh (the first-class overlapped-ring leg) and the legacy
+    # detail.mesh_scaling block share one row schema and ONE parser —
+    # the same _ingest_mesh_row the MULTICHIP dryrun tail goes through
+    mesh = detail.get("mesh") or detail.get("mesh_scaling") or {}
     rows = [
         r
         for r in (mesh.get("rows") or [])
@@ -187,14 +190,11 @@ def _bench_run_from_parsed(
         # the stable field the scaling gate reads: the best per-chip
         # rate at the HIGHEST device count the run exercised
         n_dev = max(int(r.get("devices", 1)) for r in rows)
-        best = max(
-            float(r["cells_per_sec_per_chip"])
-            for r in rows
-            if int(r.get("devices", 1)) == n_dev
+        top = max(
+            (r for r in rows if int(r.get("devices", 1)) == n_dev),
+            key=lambda r: float(r["cells_per_sec_per_chip"]),
         )
-        run.cells_per_sec_per_chip = best
-        run.n_devices = n_dev
-        run.virtual_mesh = bool(mesh.get("virtual", True))
+        _ingest_mesh_row(run, top, default_virtual=mesh.get("virtual", True))
         # efficiency needs SAME-workload endpoints: a 1-device row of
         # this very block is the only valid denominator (dividing by
         # the headline single-chip rate would compare different
@@ -206,8 +206,35 @@ def _bench_run_from_parsed(
             and isinstance(r.get("cells_per_sec"), (int, float))
         ]
         if one_dev and n_dev > 1:
-            run.scaling_efficiency = round(best / max(one_dev), 4)
+            run.scaling_efficiency = round(
+                run.cells_per_sec_per_chip / max(one_dev), 4
+            )
     return run
+
+
+def _ingest_mesh_row(
+    run: PerfRun, row: Dict[str, Any], default_virtual: Any = True
+) -> None:
+    """Fold ONE mesh row — a detail.mesh rows[] entry or the MULTICHIP
+    dryrun's tail JSON line (same schema by design) — into the PerfRun
+    mesh fields.  The single parser both artifact shapes ingest
+    through, so the dryrun and the bench leg can never drift."""
+    if isinstance(row.get("cells_per_sec_per_chip"), (int, float)):
+        run.cells_per_sec_per_chip = float(row["cells_per_sec_per_chip"])
+    if isinstance(row.get("cells_per_sec"), (int, float)):
+        # multichip runs carry no headline rate of their own; bench
+        # runs already set run.cells_per_sec from the JSON line value
+        if run.kind == "multichip" or run.cells_per_sec == 0.0:
+            run.cells_per_sec = float(row["cells_per_sec"])
+    if isinstance(row.get("devices"), int):
+        run.n_devices = row["devices"]
+    elif isinstance(row.get("n_devices"), int):
+        run.n_devices = row["n_devices"]
+    run.virtual_mesh = bool(row.get("virtual", default_virtual))
+    if isinstance(row.get("ring_step_s"), (int, float)):
+        run.mesh_ring_step_s = float(row["ring_step_s"])
+    if isinstance(row.get("overlap_efficiency"), (int, float)):
+        run.mesh_overlap_efficiency = float(row["overlap_efficiency"])
 
 
 def ingest_bench(path: str, run_id: Optional[str] = None) -> PerfRun:
@@ -324,11 +351,9 @@ def ingest_multichip(path: str, run_id: Optional[str] = None) -> PerfRun:
     if line and isinstance(
         line.get("cells_per_sec_per_chip"), (int, float)
     ):
-        run.cells_per_sec_per_chip = float(line["cells_per_sec_per_chip"])
-        run.cells_per_sec = float(line.get("cells_per_sec") or 0.0)
-        run.virtual_mesh = bool(line.get("virtual", True))
-        if isinstance(line.get("n_devices"), int):
-            run.n_devices = line["n_devices"]
+        # same schema, same parser as a bench detail.mesh row — the
+        # dryrun emits one JSON line per device count in that shape
+        _ingest_mesh_row(run, line)
     if not ok and run.error is None:
         run.error = _evidence_line(tail)
     return run

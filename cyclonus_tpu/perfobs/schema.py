@@ -62,6 +62,11 @@ class PerfRun:
     scaling_efficiency: Optional[float] = None
     n_devices: Optional[int] = None
     virtual_mesh: bool = False  # per-chip rate from a virtual CPU mesh
+    # detail.mesh row fields of the overlapped ring path (None: older
+    # artifact or leg skipped).  Report-only for now, like the serve
+    # fields: the scaling-efficiency gate above is the gated surface.
+    mesh_ring_step_s: Optional[float] = None
+    mesh_overlap_efficiency: Optional[float] = None
     warmup_s: Optional[float] = None
     # normalized per-phase wall-clock seconds: detail.phase_history_s
     # merged with the named detail.*_s timings (build/encode/...)
@@ -110,6 +115,8 @@ class PerfRun:
             "scaling_efficiency": self.scaling_efficiency,
             "n_devices": self.n_devices,
             "virtual_mesh": self.virtual_mesh,
+            "mesh_ring_step_s": self.mesh_ring_step_s,
+            "mesh_overlap_efficiency": self.mesh_overlap_efficiency,
             "warmup_s": self.warmup_s,
             "phases": dict(self.phases),
             "warmup_phases": dict(self.warmup_phases),

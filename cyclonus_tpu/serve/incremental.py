@@ -88,6 +88,19 @@ class Ineligible(Exception):
     must fall back to a full rebuild from authoritative state."""
 
 
+def serve_headroom() -> int:
+    """CYCLONUS_SERVE_HEADROOM: extra rule-slab bucket steps the serve
+    path pre-reserves at engine build (default 1 — one bucket of
+    headroom absorbs most bucket-crossing policy churn, keeping it on
+    the incremental path; 0 restores exact-fit buckets)."""
+    import os
+
+    try:
+        return max(0, int(os.environ.get("CYCLONUS_SERVE_HEADROOM", "1")))
+    except ValueError:
+        return 1
+
+
 def pow2_pad(n: int) -> int:
     """Min-8 power-of-two round-up: the one compiled-shape policy both
     padded surfaces share (scatter idx/vals in _PatchSet.flush, pair
@@ -227,8 +240,20 @@ class IncrementalEngine:
             compact=False,
             class_compress=class_compress,
             tiers=tiers,
+            # slab headroom pre-reservation (ROADMAP 1b): one extra
+            # bucket on the rule-slab axes so bucket-crossing policy
+            # churn pads into the reservation (patch_policy) instead
+            # of forcing a full rebuild
+            slab_headroom=serve_headroom(),
         )
         self._class_compress = class_compress
+        # the counterfactual ZERO-HEADROOM engine's rule-slab buckets
+        # (what a headroom-0 build would currently have allocated, had
+        # it rebuilt on every bucket change) — the baseline headroom
+        # SAVES are counted against, so a grown slab is counted once,
+        # not on every subsequent same-size patch.  Lazily derived from
+        # the allocations on the first policy patch (patch_policy).
+        self._natural_buckets: Optional[Dict[tuple, int]] = None
         # class-patch support: the per-pod signature matrix and the
         # signature -> class id index (see _class_update_row)
         self._sigs: Optional[np.ndarray] = None
@@ -548,7 +573,10 @@ class IncrementalEngine:
         base = dict(eng._tensors)
         base.update(real)
         ct = gather_class_pod_rows(base, pc.class_rep)
-        ct = engine_api._bucket_tensors(engine_api._sort_targets_by_ns(ct))
+        ct = engine_api._bucket_tensors(
+            engine_api._sort_targets_by_ns(ct),
+            headroom=eng._slab_headroom,
+        )
         st["classes"] = pc
         st["ratio"] = n / max(pc.n_classes, 1)
         st["ctensors"] = ct
@@ -632,37 +660,115 @@ class IncrementalEngine:
         merged = engine_api._bucket_tensors(
             engine_api._sort_targets_by_ns(merged)
         )
-        # every rule-slab leaf must keep its bucketed shape (compiled
-        # programs key on shapes); compare before touching anything
+        # fit the re-encoded slabs into the engine's ALLOCATED buckets
+        # (compiled programs key on shapes): each leaf pads up to its
+        # existing shape with the inert fill — into the headroom
+        # reservation (slab_headroom) when the natural bucket grew —
+        # and any axis past the allocation is a bucket overflow only a
+        # full rebuild can absorb.  All checked before anything mutates.
         old = eng._tensors
-        for k in _SEL_LEAVES:
-            if merged[k].shape != old[k].shape:
+        headroom = eng._slab_headroom
+
+        def _fit(label: str, arr: np.ndarray, old_arr: np.ndarray, fill):
+            if arr.shape == old_arr.shape:
+                return arr
+            if any(a > b for a, b in zip(arr.shape, old_arr.shape)):
                 raise Ineligible(
-                    f"selector slab {k} changes bucket "
-                    f"{old[k].shape} -> {merged[k].shape}"
+                    f"{label} outgrows its allocated bucket "
+                    f"{old_arr.shape} -> {arr.shape}"
                 )
-        def _check_slab_dict(label: str, od: Dict, nd: Dict) -> None:
+            for ax, size in enumerate(old_arr.shape):
+                arr = engine_api._pad_axis(arr, ax, size, fill)
+            return arr
+
+        def _fit_slab_dict(label: str, od: Dict, nd: Dict, pads: Dict) -> Dict:
             if set(od) != set(nd):
                 raise Ineligible(f"{label} slab key set changed")
+            out = {}
             for k in od:
                 if k == "port_spec":
-                    if set(od[k]) != set(nd[k]) or any(
-                        od[k][s].shape != nd[k][s].shape for s in od[k]
-                    ):
-                        raise Ineligible(f"{label} port_spec changes bucket")
-                elif od[k].shape != nd[k].shape:
-                    raise Ineligible(
-                        f"{label} slab {k} changes bucket "
-                        f"{od[k].shape} -> {nd[k].shape}"
-                    )
+                    if set(od[k]) != set(nd[k]):
+                        raise Ineligible(f"{label} port_spec key set changed")
+                    out[k] = {
+                        s: _fit(
+                            f"{label}.port_spec.{s}",
+                            nd[k][s],
+                            od[k][s],
+                            engine_api._PORT_SPEC_PADS[s],
+                        )
+                        for s in od[k]
+                    }
+                else:
+                    out[k] = _fit(f"{label}.{k}", nd[k], od[k], pads[k])
+            return out
 
+        # a headroom SAVE = THIS patch grew some rule-slab row axis past
+        # the counterfactual zero-headroom engine's CURRENT bucket but
+        # still fit the reservation — one full rebuild avoided
+        # (cyclonus_tpu_serve_headroom_saves_total).  The baseline is
+        # what a headroom-0 build would have allocated right now: it
+        # starts at the build-time natural buckets and follows each
+        # applied patch (a zero-headroom engine rebuilds on any bucket
+        # change, ending up at exactly the patch's natural buckets), so
+        # follow-up patches at an already-grown size count nothing.
+        # Needed sizes are read BEFORE the fit pads them to allocation;
+        # target axes are tracked in full-bucket units (allocated as
+        # bucket - 1).
+        needed_buckets: Dict[tuple, int] = {
+            ("sel",): int(merged["sel_req_kv"].shape[0]),
+        }
         for direction in ("ingress", "egress"):
-            _check_slab_dict(direction, old[direction], merged[direction])
+            nd = merged[direction]
+            needed_buckets[(direction, "target")] = (
+                int(nd["target_ns"].shape[0]) + 1
+            )
+            needed_buckets[(direction, "peer")] = int(
+                nd["peer_kind"].shape[0]
+            )
             if had_tiers:
-                _check_slab_dict(
+                needed_buckets[(direction, "tier")] = int(
+                    merged["tiers"][direction]["action"].shape[0]
+                )
+        if self._natural_buckets is None:
+            base: Dict[tuple, int] = {
+                ("sel",): engine_api._bucket_down(
+                    int(old["sel_req_kv"].shape[0]), headroom
+                ),
+            }
+            for direction in ("ingress", "egress"):
+                od = old[direction]
+                base[(direction, "target")] = engine_api._bucket_down(
+                    int(od["target_ns"].shape[0]) + 1, headroom
+                )
+                base[(direction, "peer")] = engine_api._bucket_down(
+                    int(od["peer_kind"].shape[0]), headroom
+                )
+                if had_tiers:
+                    base[(direction, "tier")] = engine_api._bucket_down(
+                        int(old["tiers"][direction]["action"].shape[0]),
+                        headroom,
+                    )
+            self._natural_buckets = base
+        saved = headroom > 0 and any(
+            needed > self._natural_buckets.get(key, needed)
+            for key, needed in needed_buckets.items()
+        )
+        for k in _SEL_LEAVES:
+            merged[k] = _fit(k, merged[k], old[k], engine_api._SEL_PADS[k])
+        for direction in ("ingress", "egress"):
+            merged[direction] = _fit_slab_dict(
+                direction,
+                old[direction],
+                merged[direction],
+                engine_api._DIRECTION_PADS,
+            )
+            if had_tiers:
+                merged["tiers"] = dict(merged.get("tiers", {}))
+                merged["tiers"][direction] = _fit_slab_dict(
                     f"tiers.{direction}",
                     old["tiers"][direction],
                     merged["tiers"][direction],
+                    engine_api._TIER_PADS,
                 )
         patch = self.main_patchset()
 
@@ -700,6 +806,11 @@ class IncrementalEngine:
         if had_tiers:
             old["tiers"] = merged["tiers"]
         self.flush_main(patch)
+        # the counterfactual zero-headroom engine has now rebuilt onto
+        # exactly this patch's natural buckets
+        self._natural_buckets = needed_buckets
+        if saved:
+            ti.SERVE_HEADROOM_SAVES.inc()
         # raw encoding follows (firing_components and the analysis layer
         # read it) + the derived host state
         enc.ingress = ingress

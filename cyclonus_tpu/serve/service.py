@@ -617,8 +617,10 @@ class VerdictService:
             # tier slabs patch like rule slabs: patch_policy re-encodes
             # the NP directions + the SHARED selector table + the tier
             # slabs together (a tier delta can grow the table the NP
-            # rows index, and vice versa), and raises Ineligible on any
-            # bucketed-shape change — including the tier slabs appearing
+            # rows index, and vice versa), fits the result into the
+            # allocated (headroom-reserved) buckets, and raises
+            # Ineligible when any slab outgrows its allocation —
+            # including the tier slabs appearing
             # on a tier-less engine or vanishing entirely, which is a
             # tensor-structure change only the full rebuild can make
             if policy_changed:

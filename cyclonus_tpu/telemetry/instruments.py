@@ -78,6 +78,21 @@ PRE_CACHE_BUDGET_BYTES = REGISTRY.gauge(
     "cyclonus_tpu_pre_cache_budget_bytes",
     "Precompute pin ceiling (engine/api.py _PRE_CACHE_MAX_BYTES).",
 )
+MESH_PEER_BYTES = REGISTRY.gauge(
+    "cyclonus_tpu_mesh_peer_buffer_bytes",
+    "Per-device peer-side working-set bytes of the last sharded grid "
+    "eval, by exchange schedule (ring = resident shard bundle + one "
+    "in-flight ppermute block; allgather = the full replicated peer "
+    "copy).  The scale-out acceptance asserts ring < allgather at 8 "
+    "devices (engine/sharded.py peer_buffer_bytes).",
+    labelnames=("schedule",),
+)
+MESH_RING_STEP_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_mesh_ring_step_seconds",
+    "Per-hop seconds of the last pipelined ring-counts eval "
+    "(pipelined eval seconds / device count): the overlapped ICI-hop "
+    "budget the bench records as detail.mesh ring_step_s.",
+)
 
 # --- equivalence-class grid compression ----------------------------------
 
@@ -224,6 +239,13 @@ SERVE_PATCH_BYTES = REGISTRY.counter(
     "cyclonus_tpu_serve_patch_bytes_total",
     "Verdict service: bytes scatter-patched into live device buffers "
     "(the incremental path's entire host->device traffic).",
+)
+SERVE_HEADROOM_SAVES = REGISTRY.counter(
+    "cyclonus_tpu_serve_headroom_saves_total",
+    "Verdict service: policy patches that crossed a rule-slab bucket "
+    "boundary but stayed on the incremental path because the serve "
+    "engine pre-reserved slab headroom (CYCLONUS_SERVE_HEADROOM) — "
+    "each one is a full rebuild avoided.",
 )
 SERVE_QUERIES = REGISTRY.counter(
     "cyclonus_tpu_serve_queries_total",

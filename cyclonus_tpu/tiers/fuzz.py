@@ -81,6 +81,7 @@ class FuzzCase:
 class FuzzReport:
     seeds: List[int] = field(default_factory=list)
     cells_checked: int = 0
+    mesh_cells_checked: int = 0  # cells re-checked via the overlapped mesh
     pair_checks: int = 0
     tiered_seeds: int = 0
 
@@ -88,6 +89,7 @@ class FuzzReport:
         return {
             "seeds": list(self.seeds),
             "cells_checked": self.cells_checked,
+            "mesh_cells_checked": self.mesh_cells_checked,
             "pair_checks": self.pair_checks,
             "tiered_seeds": self.tiered_seeds,
         }
@@ -400,8 +402,7 @@ def _oracle_table(
     return out
 
 
-def _engine_table(engine: TpuPolicyEngine, cases: List[PortCase]) -> np.ndarray:
-    grid = engine.evaluate_grid(cases)
+def _table_from_grid(grid) -> np.ndarray:
     ingress = np.asarray(grid.ingress)  # [Q, dst, src]
     egress = np.asarray(grid.egress)  # [Q, src, dst]
     combined = np.asarray(grid.combined)
@@ -410,21 +411,31 @@ def _engine_table(engine: TpuPolicyEngine, cases: List[PortCase]) -> np.ndarray:
     )  # [Q, src, dst, 3]
 
 
+def _engine_table(engine: TpuPolicyEngine, cases: List[PortCase]) -> np.ndarray:
+    return _table_from_grid(engine.evaluate_grid(cases))
+
+
 def run_seed(
     seed: int,
     *,
     modes: Tuple[str, ...] = ("0", "1"),
     check_counts: bool = True,
+    check_mesh: bool = True,
     pair_samples: int = 16,
 ) -> Dict:
     """The per-seed differential gate (module docstring).  Returns check
-    stats; raises FuzzMismatch on any divergence."""
+    stats; raises FuzzMismatch on any divergence.  check_mesh routes
+    every engine (tiered and tier-free, dense AND class-compressed)
+    through the OVERLAPPED ring mesh path too (evaluate_grid_sharded on
+    the virtual multi-device mesh) and pins it bit-identical to the
+    same oracle table — the `make fuzz` mesh leg."""
     fc = build_fuzz_case(seed)
     policy = build_network_policies(fc.simplify, fc.netpols)
     want = _oracle_table(policy, fc.tiers, fc.pods, fc.namespaces, fc.cases)
     n = len(fc.pods)
     rng = random.Random(seed ^ 0x5EED)
     pair_checks = 0
+    mesh_cells = 0
     for mode in modes:
         engine = TpuPolicyEngine(
             policy,
@@ -446,6 +457,23 @@ def run_seed(
                 f"oracle={bool(want[qi, si, di, ki])} "
                 f"({bad.shape[0]} divergent cells)"
             )
+        if check_mesh and n:
+            got_mesh = _table_from_grid(
+                engine.evaluate_grid_sharded(fc.cases, schedule="ring")
+            )
+            if not np.array_equal(got_mesh, want):
+                bad = np.argwhere(got_mesh != want)
+                qi, si, di, ki = (int(x) for x in bad[0])
+                raise FuzzMismatch(
+                    f"seed {seed} (class_compress={mode}): the "
+                    f"OVERLAPPED mesh path diverges from the tiered "
+                    f"oracle at case={fc.cases[qi]} "
+                    f"src={fc.pods[si][:2]} dst={fc.pods[di][:2]} "
+                    f"component="
+                    f"{('ingress', 'egress', 'combined')[ki]} "
+                    f"({bad.shape[0]} divergent cells)"
+                )
+            mesh_cells += int(want.size // 3)
         if check_counts:
             sums = {
                 "ingress": int(want[..., 0].sum()),
@@ -506,6 +534,7 @@ def run_seed(
         "pods": n,
         "tiered": fc.tiers is not None,
         "cells": int(want.size // 3 * len(modes)),
+        "mesh_cells": mesh_cells,
         "pair_checks": pair_checks,
         "anp_count": 0 if fc.tiers is None else len(fc.tiers.anps),
     }
@@ -517,6 +546,7 @@ def run(
     *,
     modes: Tuple[str, ...] = ("0", "1"),
     check_counts: bool = True,
+    check_mesh: bool = True,
     pair_samples: int = 16,
     log=None,
 ) -> FuzzReport:
@@ -525,16 +555,22 @@ def run(
     report = FuzzReport()
     for s in range(base_seed, base_seed + seeds):
         r = run_seed(
-            s, modes=modes, check_counts=check_counts, pair_samples=pair_samples
+            s,
+            modes=modes,
+            check_counts=check_counts,
+            check_mesh=check_mesh,
+            pair_samples=pair_samples,
         )
         report.seeds.append(s)
         report.cells_checked += r["cells"]
+        report.mesh_cells_checked += r["mesh_cells"]
         report.pair_checks += r["pair_checks"]
         report.tiered_seeds += int(r["tiered"])
         if log is not None:
             log(
                 f"seed {s}: pods={r['pods']} anps={r['anp_count']} "
-                f"tiered={r['tiered']} cells={r['cells']} OK"
+                f"tiered={r['tiered']} cells={r['cells']} "
+                f"mesh={r['mesh_cells']} OK"
             )
     return report
 

@@ -227,6 +227,12 @@ class TestBenchGuards:
         # BENCH_MEGA defaults to auto = TPU-only; on this CPU run the
         # block records as absent-by-default
         assert detail["mega_class"] is None
+        # detail.mesh rides EVERY line (perfobs' scaling gate parses its
+        # rows); with BENCH_MESH=0 the leg is skipped but the block —
+        # and its schema — still appears, rows empty
+        mesh = detail["mesh"]
+        assert mesh["rows"] == [] and mesh["skipped"] == "BENCH_MESH=0"
+        assert mesh["schedule"] == "ring"
         # the precedence-tier leg rides EVERY line (perfobs reads
         # detail.tiers warn-only): a deterministic ANP/BANP lattice
         # with oracle spot parity enforced inside the leg

@@ -1,0 +1,346 @@
+"""The overlapped ring-exchange mesh path (engine/sharded.py): parity
+against the single-device kernel and the all-gather reference schedule
+at 1/2/4/8 virtual devices (uneven pod/device divisions included), the
+tiered and class-compressed routes, the peer-buffer HBM watermark claim
+(ring < allgather), the double-buffered pipelined counts twin, the
+min-of-5 overlapped-vs-allgather throughput differential, and the
+zero-recompile elastic-resize contract (same-bucket cluster resizes
+reuse every compiled sharded program)."""
+
+import random
+import time
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
+from cyclonus_tpu.engine import sharded as sharded_mod
+from cyclonus_tpu.engine.api import _bucket_down, _bucket_pods, _bucket_up
+from cyclonus_tpu.matcher import build_network_policies
+from cyclonus_tpu.telemetry import instruments as ti
+
+from test_engine_tiled import CASES, fuzz_problem
+
+
+def cpu_mesh(n_dev):
+    import jax
+
+    cpu = jax.devices("cpu")
+    if len(cpu) < n_dev:
+        pytest.skip(f"needs {n_dev} CPU devices, have {len(cpu)}")
+    return Mesh(np.array(cpu[:n_dev]), ("x",))
+
+
+def grids_equal(a, b):
+    return all(
+        np.array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        )
+        for name in ("ingress", "egress", "combined")
+    )
+
+
+def synthetic_engine(n_pods, n_pols=6, seed=3, **kw):
+    from bench import build_synthetic
+
+    pods, namespaces, policies = build_synthetic(
+        n_pods, n_pols, random.Random(seed)
+    )
+    policy = build_network_policies(True, policies)
+    return TpuPolicyEngine(policy, pods, namespaces, **kw), policy, pods
+
+
+class TestRingParity:
+    @pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_ring_matches_single_device_uneven(self, seed, n_dev):
+        """Overlapped ring grid == single-device kernel at every mesh
+        width, with pod counts that do NOT divide the device count
+        (padded rows must stay inert)."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=4)
+        assert len(pods) % 8 != 0  # 13 pods: uneven over every mesh
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ref = engine.evaluate_grid(CASES)
+        ring = engine.evaluate_grid_sharded(
+            CASES, mesh=cpu_mesh(n_dev), schedule="ring"
+        )
+        assert grids_equal(ring, ref)
+        # pad rows stripped: the grid is exactly n x n
+        n = len(pods)
+        assert np.asarray(ring.combined).shape == (len(CASES), n, n)
+
+    @pytest.mark.parametrize("seed", [1, 4])
+    def test_ring_bit_identical_to_allgather(self, seed):
+        """The overlapped schedule and the all-gather reference must
+        produce the SAME truth tables bit for bit."""
+        policy, pods, namespaces = fuzz_problem(seed, n_extra_pods=2)
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        mesh = cpu_mesh(8)
+        ring = engine.evaluate_grid_sharded(CASES, mesh=mesh, schedule="ring")
+        ag = engine.evaluate_grid_sharded(
+            CASES, mesh=mesh, schedule="allgather"
+        )
+        assert grids_equal(ring, ag)
+
+    def test_ring_tiered_engine(self):
+        """The precedence-tier epilogue resolves INSIDE the ring step:
+        a tiered engine's overlapped grid must equal the single-device
+        tiered kernel."""
+        from cyclonus_tpu.kube.netpol import IntOrString, LabelSelector
+        from cyclonus_tpu.tiers.model import (
+            AdminNetworkPolicy,
+            BaselineAdminNetworkPolicy,
+            TierPort,
+            TierRule,
+            TierScope,
+            TierSet,
+        )
+
+        policy, pods, namespaces = fuzz_problem(7, n_extra_pods=4)
+        tiers = TierSet(
+            anps=[
+                AdminNetworkPolicy(
+                    name="deny-a",
+                    priority=5,
+                    subject=TierScope(
+                        pod_selector=LabelSelector.make({"pod": "a"})
+                    ),
+                    ingress=[
+                        TierRule(
+                            action="Deny",
+                            peers=[TierScope(
+                                pod_selector=LabelSelector.make({"pod": "b"})
+                            )],
+                            ports=[TierPort(
+                                protocol="TCP", port=IntOrString(80)
+                            )],
+                        )
+                    ],
+                )
+            ],
+            banp=BaselineAdminNetworkPolicy(
+                subject=TierScope(
+                    pod_selector=LabelSelector.make({"pod": "c"})
+                ),
+                ingress=[TierRule(action="Deny", peers=[TierScope()])],
+            ),
+        )
+        engine = TpuPolicyEngine(policy, pods, namespaces, tiers=tiers)
+        ref = engine.evaluate_grid(CASES)
+        ring = engine.evaluate_grid_sharded(
+            CASES, mesh=cpu_mesh(8), schedule="ring"
+        )
+        assert grids_equal(ring, ref)
+
+    def test_ring_class_compressed_engine(self):
+        """The compressed route is a C x C ring over class
+        representatives + the gather epilogue; still bit-identical to
+        the dense single-device grid."""
+        policy, pods, namespaces = fuzz_problem(2, n_extra_pods=6)
+        engine = TpuPolicyEngine(
+            policy, pods, namespaces, class_compress="1"
+        )
+        assert engine.pod_classes() is not None
+        ref_engine = TpuPolicyEngine(
+            policy, pods, namespaces, class_compress="0"
+        )
+        ref = ref_engine.evaluate_grid(CASES)
+        ring = engine.evaluate_grid_sharded(
+            CASES, mesh=cpu_mesh(8), schedule="ring"
+        )
+        assert grids_equal(ring, ref)
+
+    def test_ring_ipv6_host_rows(self):
+        """Host-evaluated (IPv6) peer rows ride the pod-sharded
+        host_ip_match columns through the ring like every other per-pod
+        array."""
+        from cyclonus_tpu.kube.netpol import (
+            IPBlock,
+            LabelSelector,
+            NetworkPolicyIngressRule,
+            NetworkPolicyPeer,
+        )
+        from test_engine_parity import default_cluster, mkpol
+
+        pods, namespaces = default_cluster()
+        pods = [
+            (ns, name, labels, f"2001:db8::{i + 1}")
+            for i, (ns, name, labels, _ip) in enumerate(pods)
+        ]
+        policy = build_network_policies(
+            True,
+            [
+                mkpol(
+                    "v6",
+                    "x",
+                    LabelSelector.make(),
+                    ["Ingress"],
+                    ingress=[
+                        NetworkPolicyIngressRule(
+                            ports=[],
+                            from_=[
+                                NetworkPolicyPeer(
+                                    ip_block=IPBlock.make(
+                                        "2001:db8::/112",
+                                        ["2001:db8::4/126"],
+                                    )
+                                )
+                            ],
+                        )
+                    ],
+                )
+            ],
+        )
+        engine = TpuPolicyEngine(policy, pods, namespaces)
+        ref = engine.evaluate_grid(CASES)
+        ring = engine.evaluate_grid_sharded(
+            CASES, mesh=cpu_mesh(8), schedule="ring"
+        )
+        assert grids_equal(ring, ref)
+
+
+class TestMeshCounts:
+    def test_pipelined_twin_matches_counts(self):
+        """The double-buffered pipelined mesh twin must return the same
+        counts as the sync ring path and the single-device engine."""
+        engine, _policy, _pods = synthetic_engine(13)
+        want = engine.evaluate_grid_counts(CASES, block=4, backend="xla")
+        mesh = cpu_mesh(8)
+        sync = engine.evaluate_grid_counts_ring(CASES, block=4, mesh=mesh)
+        assert sync == want
+        dt, counts = engine.mesh_counts_pipelined_eval_s(
+            CASES, reps=3, block=4, mesh=mesh
+        )
+        assert counts == want
+        assert dt > 0
+        assert ti.MESH_RING_STEP_SECONDS.value() > 0
+
+    def test_pipelined_twin_tiered(self):
+        """Tier slabs rotate with the bundle: the pipelined twin on a
+        tiered engine equals the tiered counts engine."""
+        from cyclonus_tpu.kube.netpol import LabelSelector
+        from cyclonus_tpu.tiers.model import (
+            AdminNetworkPolicy,
+            TierRule,
+            TierScope,
+            TierSet,
+        )
+
+        policy, pods, namespaces = fuzz_problem(9, n_extra_pods=4)
+        tiers = TierSet(
+            anps=[
+                AdminNetworkPolicy(
+                    name="deny-b",
+                    priority=3,
+                    subject=TierScope(),
+                    egress=[
+                        TierRule(
+                            action="Deny",
+                            peers=[TierScope(
+                                pod_selector=LabelSelector.make({"pod": "b"})
+                            )],
+                        )
+                    ],
+                )
+            ]
+        )
+        engine = TpuPolicyEngine(policy, pods, namespaces, tiers=tiers)
+        want = engine.evaluate_grid_counts(CASES, block=4)
+        dt, counts = engine.mesh_counts_pipelined_eval_s(
+            CASES, reps=2, block=4, mesh=cpu_mesh(4)
+        )
+        assert counts == want
+
+    def test_overlapped_beats_allgather_throughput_min_of_5(self):
+        """The min-of-5 throughput differential: the OVERLAPPED path —
+        pipelined ring counts, peer bundle double-buffered and donated,
+        per-eval transfer/precompute amortized away — must sustain at
+        least the all-gather-style path's throughput (the replicated
+        sharded counts, which re-transfers and replicates the full
+        peer-side precompute per eval) on the virtual 8-device mesh.
+        min-of-5 per leg absorbs scheduler noise; the measured gap is
+        several-fold, so the bound has real margin."""
+        engine, _policy, _pods = synthetic_engine(512, n_pols=48, seed=11)
+        mesh = cpu_mesh(8)
+
+        def run_allgather():
+            return engine.evaluate_grid_counts_sharded(
+                CASES, block=256, mesh=mesh, kernel="xla"
+            )
+
+        want = run_allgather()  # compile outside the timing
+        ag_s = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            counts = run_allgather()
+            ag_s = min(ag_s, time.perf_counter() - t0)
+        # the pipelined twin is already a min-style amortization: reps
+        # back-to-back dispatches, one barrier
+        ring_s, ring_counts = engine.mesh_counts_pipelined_eval_s(
+            CASES, reps=5, block=256, mesh=mesh
+        )
+        assert ring_counts == want
+        assert ring_s <= ag_s, (ring_s, ag_s)
+
+
+class TestPeerBufferWatermark:
+    def test_ring_under_allgather_at_8_devices(self):
+        """The scale-out acceptance: the overlapped schedule's peak
+        per-device peer-buffer bytes (resident shard bundle + one
+        in-flight block) must undercut the all-gather schedule's
+        replicated peer copy at 8 devices — asserted through the SAME
+        gauge the bench records."""
+        engine, _policy, pods = synthetic_engine(64, n_pols=10)
+        mesh = cpu_mesh(8)
+        engine.evaluate_grid_sharded(CASES, mesh=mesh, schedule="ring")
+        ring_bytes = ti.MESH_PEER_BYTES.value(schedule="ring")
+        engine.evaluate_grid_sharded(CASES, mesh=mesh, schedule="allgather")
+        ag_bytes = ti.MESH_PEER_BYTES.value(schedule="allgather")
+        assert 0 < ring_bytes < ag_bytes
+        # the host-side estimator agrees with what the gauges recorded
+        t = engine._tensors_with_cases(CASES)
+        t, _ = sharded_mod._pad_pod_arrays(t, len(pods), 8)
+        assert ring_bytes == sharded_mod.peer_buffer_bytes(t, 8, "ring")
+        assert ag_bytes == sharded_mod.peer_buffer_bytes(t, 8, "allgather")
+
+
+class TestElasticResize:
+    def test_bucket_step_helpers_invert(self):
+        for b in (4, 8, 16, 64, 128, 256, 384, 512, 1024):
+            assert _bucket_down(_bucket_up(b, 1), 1) == b
+            assert _bucket_down(_bucket_up(b, 2), 2) == b
+        assert _bucket_down(4, 3) == 4  # floored at the smallest bucket
+
+    def test_same_bucket_resize_zero_retrace(self):
+        """The zero-recompile elastic-resize contract: a +-10% pod
+        resize within one _bucket_pods bucket must not add a single
+        trace to the shared grid kernel or the cached sharded (ring)
+        program — the bucketing makes the shapes identical, so the jit
+        caches hit."""
+        from bench import build_synthetic
+        from cyclonus_tpu.engine.kernel import evaluate_grid_kernel
+
+        n_a, n_b = 900, 990  # +10%: both bucket to 1024
+        assert _bucket_pods(n_a) == _bucket_pods(int(n_a * 1.1))
+        pods, namespaces, policies = build_synthetic(
+            n_b, 8, random.Random(11)
+        )
+        policy = build_network_policies(True, policies)
+        mesh = cpu_mesh(8)
+        eng_a = TpuPolicyEngine(policy, pods[:n_a], namespaces)
+        eng_a.evaluate_grid(CASES)
+        eng_a.evaluate_grid_sharded(CASES, mesh=mesh, schedule="ring")
+        kernel_traces = evaluate_grid_kernel._cache_size()
+        ring_fns = {
+            id(fn): fn._cache_size()
+            for fn in sharded_mod._SHARDED_PROGRAMS.values()
+        }
+        eng_b = TpuPolicyEngine(policy, pods, namespaces)
+        eng_b.evaluate_grid(CASES)
+        eng_b.evaluate_grid_sharded(CASES, mesh=mesh, schedule="ring")
+        assert evaluate_grid_kernel._cache_size() == kernel_traces
+        for fn in sharded_mod._SHARDED_PROGRAMS.values():
+            assert fn._cache_size() == ring_fns.get(id(fn), 0), (
+                "same-bucket resize retraced a sharded program"
+            )

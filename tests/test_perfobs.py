@@ -250,6 +250,59 @@ class TestLedger:
         assert run.n_devices == 8
         assert run.virtual_mesh is False
 
+    def test_multichip_mesh_row_schema_shared_parser(self, tmp_path):
+        """The new dryrun emits the SAME row schema as a bench
+        detail.mesh row; both ingest through _ingest_mesh_row, so the
+        ring fields land on the PerfRun either way."""
+        row = {
+            "metric": "multichip ring counts cells/sec",
+            "path": "ring", "devices": 8, "n_devices": 8,
+            "eval_s": 0.5, "pipelined_eval_s": 0.08,
+            "cells_per_sec": 8.0e9, "cells_per_sec_per_chip": 1.0e9,
+            "ring_step_s": 0.01, "overlap_efficiency": 0.9,
+            "counts_ok": True, "virtual": True,
+        }
+        p = tmp_path / "MULTICHIP_r02.json"
+        p.write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True,
+             "tail": "dryrun_multichip OK\n" + json.dumps(row) + "\n"}
+        ))
+        run = ingest_multichip(str(p))
+        assert run.cells_per_sec_per_chip == 1.0e9
+        assert run.mesh_ring_step_s == 0.01
+        assert run.mesh_overlap_efficiency == 0.9
+        assert run.virtual_mesh is True
+        # round-trips through the schema
+        assert PerfRun.from_dict(run.to_dict()).mesh_ring_step_s == 0.01
+
+    def test_bench_detail_mesh_preferred_over_legacy(self, tmp_path):
+        """A bench line with the new detail.mesh block ingests its
+        rows (ring fields included); legacy detail.mesh_scaling remains
+        the fallback for old artifacts."""
+        line = healthy_line(value=1e9)
+        line["detail"]["mesh"] = {
+            "pods": 64, "virtual": False, "schedule": "ring",
+            "rows": [
+                {"path": "ring", "devices": 1, "eval_s": 1.0,
+                 "cells_per_sec": 10e9, "cells_per_sec_per_chip": 10e9,
+                 "ring_step_s": 0.2, "overlap_efficiency": 1.0,
+                 "counts_ok": True, "virtual": False},
+                {"path": "ring", "devices": 8, "eval_s": 1.0,
+                 "cells_per_sec": 64e9, "cells_per_sec_per_chip": 8e9,
+                 "ring_step_s": 0.025, "overlap_efficiency": 0.8,
+                 "counts_ok": True, "virtual": False},
+            ],
+        }
+        p = tmp_path / "BENCH_r09.json"
+        p.write_text(json.dumps(wrap(9, line)))
+        run = ingest_bench(str(p))
+        assert run.n_devices == 8
+        assert run.cells_per_sec_per_chip == 8e9
+        assert run.scaling_efficiency == pytest.approx(0.8)
+        assert run.virtual_mesh is False
+        assert run.mesh_ring_step_s == 0.025
+        assert run.mesh_overlap_efficiency == 0.8
+
 
 # --- the regression sentinel ---------------------------------------------
 
@@ -877,9 +930,10 @@ class TestWiring:
 
 class TestMeshScalingPerChip:
     def test_rows_carry_per_chip_rate(self):
-        """mesh_scaling rows record cells_per_sec_per_chip (the stable
-        field the scaling gate reads) and the block self-identifies as
-        virtual so the sentinel reports without gating."""
+        """detail.mesh rows record the stable fields the scaling gate
+        reads (cells_per_sec_per_chip) plus the overlapped-path fields
+        (ring_step_s, overlap_efficiency), and the block self-identifies
+        as virtual so the sentinel reports without gating."""
         import random as _random
 
         import bench
@@ -888,11 +942,21 @@ class TestMeshScalingPerChip:
         from cyclonus_tpu.engine import PortCase
 
         cases = [PortCase(80, "serve-80-tcp", "TCP")]
-        detail = bench.mesh_scaling(pods, ns, pols, cases)
+        detail = bench.mesh_case(pods, ns, pols, cases)
         assert detail["virtual"] is True
+        assert detail["schedule"] == "ring"
         assert detail["rows"], "no mesh rows produced"
         for row in detail["rows"]:
             assert row["cells_per_sec_per_chip"] is not None
             assert row["cells_per_sec"] == pytest.approx(
                 row["cells_per_sec_per_chip"] * row["devices"], rel=0.01
             )
+            assert row["ring_step_s"] is not None
+            assert row["counts_ok"] is True
+            assert row["virtual"] is True
+        assert detail["rows"][0]["overlap_efficiency"] == 1.0
+        # the overlapped schedule's peer-buffer watermark undercuts the
+        # all-gather schedule's replicated copy on the 8-device mesh
+        pb = detail["peer_buffer_bytes"]
+        assert pb["ring"] < pb["allgather"]
+        assert detail["grid_parity"]["bit_identical"] is True

@@ -1,0 +1,257 @@
+"""Serve slab-headroom pre-reservation (ROADMAP 1b) and the
+zero-recompile elastic-resize contract at the service level.
+
+The serve path builds its engine with CYCLONUS_SERVE_HEADROOM (default
+1) extra rule-slab buckets, so a policy upsert that crosses the natural
+bucket boundary pads into the reservation and stays on the INCREMENTAL
+patch path — counted in cyclonus_tpu_serve_headroom_saves_total — where
+a zero-headroom engine must fall back to a full rebuild.  Pod churn
+within the bucketed pod axis must never retrace the query path's
+compiled programs."""
+
+import random
+
+import pytest
+
+from cyclonus_tpu.kube.netpol import (
+    IntOrString,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.kube.yaml_io import policy_to_dict
+from cyclonus_tpu.serve import VerdictService
+from cyclonus_tpu.telemetry import instruments as ti
+from cyclonus_tpu.worker.model import Delta, FlowQuery
+
+
+def mkpol(i):
+    """One ingress policy contributing exactly one distinct target and
+    one distinct peer row (no partition-compression merging)."""
+    return NetworkPolicy(
+        name=f"p{i}",
+        namespace="x",
+        spec=NetworkPolicySpec(
+            pod_selector=LabelSelector.make({"app": f"app{i}"}),
+            policy_types=["Ingress"],
+            ingress=[
+                NetworkPolicyIngressRule(
+                    ports=[
+                        NetworkPolicyPort(
+                            protocol="TCP", port=IntOrString(80)
+                        )
+                    ],
+                    from_=[
+                        NetworkPolicyPeer(
+                            pod_selector=LabelSelector.make(
+                                {"tier": f"tier{i}"}
+                            )
+                        )
+                    ],
+                )
+            ],
+        ),
+    )
+
+
+def boundary_cluster():
+    """12 pods + 15 policies: the ingress target axis sits exactly at a
+    bucket boundary (_bucket_dim(16) - 1 = 15 rows), so one more policy
+    crosses it."""
+    pods = [
+        (
+            "x",
+            f"pod-{i}",
+            {"app": f"app{i % 20}", "tier": f"tier{i % 20}"},
+            f"10.0.0.{i + 1}",
+        )
+        for i in range(12)
+    ]
+    namespaces = {"x": {"ns": "x"}}
+    return pods, namespaces, [mkpol(i) for i in range(15)]
+
+
+def upsert(i):
+    return Delta(
+        kind="policy_upsert",
+        namespace="x",
+        name=f"p{i}",
+        policy=policy_to_dict(mkpol(i)),
+    )
+
+
+class TestServeHeadroom:
+    def test_bucket_boundary_upsert_stays_incremental(self, monkeypatch):
+        """With the default headroom (1), a +1-rule upsert at the
+        bucket boundary patches the live buffer (no full rebuild), the
+        saves counter increments, and the patched engine stays
+        bit-identical to a fresh rebuild."""
+        monkeypatch.delenv("CYCLONUS_SERVE_HEADROOM", raising=False)
+        pods, namespaces, policies = boundary_cluster()
+        svc = VerdictService(pods, namespaces, policies)
+        # the reservation is real: one extra bucket on the target axis
+        assert (
+            svc.engine._tensors["ingress"]["target_ns"].shape[0] == 31
+        )  # _bucket_up(16, 1) - 1
+        saves0 = ti.SERVE_HEADROOM_SAVES.value()
+        report = svc.apply([upsert(15)])
+        assert report["mode"] in ("incremental", "class_rebuild"), report
+        assert ti.SERVE_HEADROOM_SAVES.value() - saves0 == 1
+        # differential: patched engine == fresh rebuild == oracle
+        svc.verify_parity(oracle_samples=8)
+        # the new policy actually enforces: app15 pods only admit tier15
+        keys = list(svc.pods)
+        verdicts = svc.query(
+            [
+                FlowQuery(
+                    src="x/pod-0",
+                    dst="x/pod-15" if "x/pod-15" in svc.pods else keys[0],
+                    port=80,
+                    protocol="TCP",
+                    port_name="serve-80-tcp",
+                )
+            ]
+        )
+        assert verdicts and verdicts[0].error == ""
+        # the save counts ONCE: a later within-bucket change at the
+        # already-grown size is not another rebuild avoided (the
+        # counterfactual zero-headroom engine would have rebuilt once
+        # and then fit) — the counter must not move again
+        changed = mkpol(3)
+        changed.spec.ingress[0].from_[0] = NetworkPolicyPeer(
+            pod_selector=LabelSelector.make({"tier": "tier9"})
+        )
+        report2 = svc.apply(
+            [
+                Delta(
+                    kind="policy_upsert",
+                    namespace="x",
+                    name="p3",
+                    policy=policy_to_dict(changed),
+                )
+            ]
+        )
+        assert report2["mode"] in ("incremental", "class_rebuild"), report2
+        assert ti.SERVE_HEADROOM_SAVES.value() - saves0 == 1
+        svc.verify_parity(oracle_samples=8)
+
+    def test_without_headroom_falls_back_to_rebuild(self, monkeypatch):
+        """CYCLONUS_SERVE_HEADROOM=0 restores exact-fit buckets: the
+        same boundary upsert is Ineligible and takes the full-rebuild
+        fallback (still correct, just not incremental)."""
+        monkeypatch.setenv("CYCLONUS_SERVE_HEADROOM", "0")
+        pods, namespaces, policies = boundary_cluster()
+        svc = VerdictService(pods, namespaces, policies)
+        assert svc.engine._tensors["ingress"]["target_ns"].shape[0] == 15
+        saves0 = ti.SERVE_HEADROOM_SAVES.value()
+        report = svc.apply([upsert(15)])
+        assert report["mode"] == "full", report
+        assert ti.SERVE_HEADROOM_SAVES.value() == saves0
+        svc.verify_parity(oracle_samples=8)
+
+    def test_within_bucket_upsert_counts_no_save(self, monkeypatch):
+        """A policy CHANGE that stays inside the natural bucket patches
+        incrementally without touching the saves counter — the counter
+        records only rebuilds the reservation avoided."""
+        monkeypatch.delenv("CYCLONUS_SERVE_HEADROOM", raising=False)
+        pods, namespaces, policies = boundary_cluster()
+        svc = VerdictService(pods, namespaces, policies)
+        saves0 = ti.SERVE_HEADROOM_SAVES.value()
+        changed = NetworkPolicy(
+            name="p0",
+            namespace="x",
+            spec=NetworkPolicySpec(
+                pod_selector=LabelSelector.make({"app": "app0"}),
+                policy_types=["Ingress"],
+                ingress=[
+                    NetworkPolicyIngressRule(
+                        ports=[
+                            NetworkPolicyPort(
+                                protocol="UDP", port=IntOrString(81)
+                            )
+                        ],
+                        from_=[
+                            NetworkPolicyPeer(
+                                pod_selector=LabelSelector.make(
+                                    {"tier": "tier3"}
+                                )
+                            )
+                        ],
+                    )
+                ],
+            ),
+        )
+        report = svc.apply(
+            [
+                Delta(
+                    kind="policy_upsert",
+                    namespace="x",
+                    name="p0",
+                    policy=policy_to_dict(changed),
+                )
+            ]
+        )
+        assert report["mode"] in ("incremental", "class_rebuild"), report
+        assert ti.SERVE_HEADROOM_SAVES.value() == saves0
+        svc.verify_parity(oracle_samples=8)
+
+
+class TestServeElasticResize:
+    def test_pod_resize_within_bucket_zero_retrace(self):
+        """±10% pod churn inside the bucketed pod axis: every apply
+        stays incremental (no re-encode, no re-device_put) and the
+        query path's compiled pair program is reused — the serve-level
+        zero-recompile resize contract."""
+        from cyclonus_tpu import telemetry
+        from cyclonus_tpu.engine.tiled import evaluate_pairs_kernel
+
+        rng = random.Random(5)
+        n = 56  # buckets to 64: room for the +10% growth below
+        pods = [
+            (
+                "x",
+                f"pod-{i}",
+                {"app": f"app{i % 5}", "tier": f"tier{i % 3}"},
+                f"10.0.1.{i + 1}",
+            )
+            for i in range(n)
+        ]
+        namespaces = {"x": {"ns": "x"}}
+        svc = VerdictService(pods, namespaces, [mkpol(i) for i in range(4)])
+        warm = FlowQuery(
+            src="x/pod-0", dst="x/pod-1", port=80, protocol="TCP",
+            port_name="serve-80-tcp",
+        )
+        svc.query([warm])
+        traces0 = evaluate_pairs_kernel._cache_size()
+        spans = telemetry.SPANS.stats()
+        encodes0 = spans.get("engine.encode", {}).get("count", 0)
+        puts0 = spans.get("engine.device_put", {}).get("count", 0)
+        # grow ~10%, then shrink back — all inside the 64-row bucket
+        for i in range(6):
+            report = svc.apply(
+                [
+                    Delta(
+                        kind="pod_add",
+                        namespace="x",
+                        name=f"extra-{i}",
+                        labels={"app": f"app{rng.randrange(5)}"},
+                        ip=f"10.0.2.{i + 1}",
+                    )
+                ]
+            )
+            assert report["mode"] in ("incremental", "class_rebuild")
+        for i in range(6):
+            report = svc.apply(
+                [Delta(kind="pod_remove", namespace="x", name=f"extra-{i}")]
+            )
+            assert report["mode"] in ("incremental", "class_rebuild")
+        svc.query([warm])
+        assert evaluate_pairs_kernel._cache_size() == traces0
+        spans = telemetry.SPANS.stats()
+        assert spans.get("engine.encode", {}).get("count", 0) == encodes0
+        assert spans.get("engine.device_put", {}).get("count", 0) == puts0
+        svc.verify_parity(oracle_samples=8)
