@@ -108,6 +108,36 @@ def _phase_history() -> list:
     return [list(h) for h in history]
 
 
+def _pack_detail(engine=None) -> dict:
+    """detail.pack — on EVERY bench line, success and failure (the
+    perfobs ledger and the guard tests read it unconditionally).  With
+    a live engine: the full pack_stats (dtype plan, packed word depths,
+    tuned tile winner, autotune search forensics).  Before an engine
+    exists (init failures, watchdog lines): the env-resolved plan alone,
+    with winner/autotune null."""
+    if engine is not None:
+        try:
+            return engine.pack_stats()
+        except Exception:  # noqa: BLE001 — a reporting helper never kills a line
+            pass
+    try:
+        from cyclonus_tpu.engine.encoding import pack_enabled
+
+        active = pack_enabled()
+    except Exception:  # noqa: BLE001
+        active = None
+    return {
+        "active": active,
+        "dtype": "packed32" if active else os.environ.get(
+            "CYCLONUS_PALLAS_DTYPE", "int8"
+        ),
+        "words": None,
+        "winner": None,
+        "autotune": None,
+        "cache_path": None,
+    }
+
+
 def _error_json(
     msg: str,
     extra_detail: dict = None,
@@ -118,7 +148,7 @@ def _error_json(
     or inside the measured pipeline (engine/watchdog_stall — a real
     regression).  Call sites pass what they KNOW; 'engine' is the
     conservative default for an unattributed crash."""
-    detail = {"phase_history_s": _phase_history()}
+    detail = {"phase_history_s": _phase_history(), "pack": _pack_detail()}
     if extra_detail:
         detail.update(extra_detail)
     return json.dumps(
@@ -442,17 +472,21 @@ def run_compiled_parity(rng):
     if jax.default_backend() != "tpu":
         return {"cases": 0, "ok": None, "skipped": "not on tpu"}
     cases_spec = [
-        # (pods, policies, compact, dtype, slab) — compact=False forces
-        # the multi-chunk general kernel (dead targets stay, T > 1024);
-        # slab=True forces the per-tile target-slab kernel (eligible at
-        # >= 2*SLAB_BS bucketed pods).  Pod counts bucket to
-        # 2048/3072/4096/5120/6144/8192 respectively.
-        (2048, 300, True, "int8", False),
-        (2304, 300, True, "bf16", False),  # odd pod count: bucketing pads
-        (4096, 1500, False, "int8", False),
-        (4104, 1500, False, "bf16", False),  # -> 5120 bucket
-        (6144, 600, True, "int8", False),
-        (8192, 800, True, "int8", True),  # Mosaic-compiles the slab kernel
+        # (pods, policies, compact, dtype, slab, pack) — compact=False
+        # forces the multi-chunk general kernel (dead targets stay,
+        # T > 1024); slab=True forces the per-tile target-slab kernel
+        # (eligible at >= 2*SLAB_BS bucketed pods); pack=True compiles
+        # the bit-packed word kernel (the production default plan —
+        # dense cases pin the CYCLONUS_PACK=0 fallback kernels).  Pod
+        # counts use distinct buckets per dtype plan.
+        (2048, 300, True, "int8", False, False),
+        (2304, 300, True, "bf16", False, False),  # odd count: bucketing pads
+        (4096, 1500, False, "int8", False, False),
+        (4104, 1500, False, "bf16", False, False),  # -> 5120 bucket
+        (6144, 600, True, "int8", False, False),
+        (8192, 800, True, "int8", True, False),  # Mosaic-compiles the slab
+        (3072, 400, True, "int8", False, True),  # packed word kernel
+        (10240, 1500, False, "int8", False, True),  # packed, deep target axis
     ]
     port_cases = [
         PortCase(80, "serve-80-tcp", "TCP"),
@@ -460,13 +494,14 @@ def run_compiled_parity(rng):
     ]
     failures = []
     errors = []  # non-verdict breakage (compile/run) in OPTIONAL paths
-    for pods_n, pols_n, compact, dtype, slab in cases_spec:
+    for pods_n, pols_n, compact, dtype, slab, pack in cases_spec:
         saved = {
             k: os.environ.get(k)
             for k in (
                 "CYCLONUS_COMPACT",
                 "CYCLONUS_PALLAS_DTYPE",
                 "CYCLONUS_PALLAS_SLAB",
+                "CYCLONUS_PACK",
             )
         }
         try:
@@ -474,6 +509,7 @@ def run_compiled_parity(rng):
             os.environ["CYCLONUS_COMPACT"] = "1" if compact else "0"
             os.environ["CYCLONUS_PALLAS_DTYPE"] = dtype
             os.environ["CYCLONUS_PALLAS_SLAB"] = "1" if slab else "0"
+            os.environ["CYCLONUS_PACK"] = "1" if pack else "0"
             pods, namespaces, policies = build_synthetic(
                 pods_n, pols_n, random.Random(rng.randrange(1 << 30))
             )
@@ -489,7 +525,7 @@ def run_compiled_parity(rng):
                 # the default path's coverage at this shape is not lost
                 # (a shared-pipeline crash here must still be fatal)
                 record = {
-                    "case": [pods_n, pols_n, compact, dtype, slab],
+                    "case": [pods_n, pols_n, compact, dtype, slab, pack],
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
                 if not slab:
@@ -503,12 +539,12 @@ def run_compiled_parity(rng):
             want = engine.evaluate_grid_counts(port_cases, backend="xla")
             if got != want:
                 failures.append(
-                    {"case": [pods_n, pols_n, compact, dtype, slab],
+                    {"case": [pods_n, pols_n, compact, dtype, slab, pack],
                      "pallas": got, "xla": want}
                 )
             if slab and engine._slab_plan_state is None:
                 errors.append(
-                    {"case": [pods_n, pols_n, compact, dtype, slab],
+                    {"case": [pods_n, pols_n, compact, dtype, slab, pack],
                      "error": "slab case fell back (plan ineligible)"}
                 )
         finally:
@@ -539,43 +575,84 @@ def roofline_model(engine, q: int, eval_s: float) -> dict:
       - vpu_s: the per-cell epilogue (2 compares, 1 and, ~3 reduce ops
         per cell amortized) at ~4e12 int ops/s — the floor that fusing
         exists to expose.
+    Under the PACKED dtype plan (detail.pack) the contraction leaves the
+    MXU entirely: the word AND/OR steps are VPU work over ceil(T/32)
+    int32 words per direction — vpu_s absorbs the contraction term,
+    mxu_s_dense drops out, and operand bytes shrink to the packed words.
     efficiency = roofline_s / eval_s (1.0 = at the modeled limit)."""
-    from cyclonus_tpu.engine.pallas_kernel import _kt_for, _tiles_for
+    from cyclonus_tpu.engine.encoding import packed_words
+    from cyclonus_tpu.engine.pallas_kernel import (
+        PACKED_BD,
+        PACKED_BS,
+        _kt_for,
+        _tiles_for,
+        lane_round_up,
+    )
 
     hbm_bps = 819e9  # v5e HBM
     mxu_int8 = 394.7e12  # v5e peak int8 MACs*2/s
     vpu_ops = 4e12  # ~8x128 lanes * 4 ALUs * ~1 GHz (approximate)
 
-    dtype = os.environ.get("CYCLONUS_PALLAS_DTYPE", "int8")
-    t_e = int(engine._tensors["egress"]["target_ns"].shape[0]) + 1
-    t_i = int(engine._tensors["ingress"]["target_ns"].shape[0]) + 1
-    kt_e, kt_i = _kt_for(t_e), _kt_for(t_i)
+    # the dense kernels append one pseudo-target row per direction; the
+    # packed kernel does NOT (flags ride a separate word), so the raw
+    # target counts feed the packed branch and +1 only the dense one —
+    # keeping detail.roofline.kt consistent with detail.pack.words
+    t_e_raw = int(engine._tensors["egress"]["target_ns"].shape[0])
+    t_i_raw = int(engine._tensors["ingress"]["target_ns"].shape[0])
+    t_e, t_i = t_e_raw + 1, t_i_raw + 1
     n_b = int(engine._tensors["pod_ns_id"].shape[0])
-    single = kt_e >= t_e and kt_i >= t_i
-    bs, bd = _tiles_for(
-        kt_e, kt_i, n_b,
-        single_chunk_int8=single and dtype == "int8",
-        n_dst=n_b,
-    )
-    ns_pad = -(-n_b // bs) * bs
-    nd_pad = -(-n_b // bd) * bd
-    n_i, n_j = ns_pad // bs, nd_pad // bd
-    opb = 2 if dtype == "bf16" else 1  # bytes per operand element
-    hbm_bytes = opb * q * n_i * (
-        bs * (kt_e + kt_i) + n_j * bd * (kt_e + kt_i)
-    )
-    mxu_ops = 2 * q * ns_pad * nd_pad * (kt_e + kt_i)
-    vpu_cell_ops = 6 * q * ns_pad * nd_pad
-    comp = {
-        "hbm_s": hbm_bytes / hbm_bps,
-        "mxu_s_dense": mxu_ops / (mxu_int8 if dtype == "int8" else mxu_int8 / 2),
-        "vpu_s": vpu_cell_ops / vpu_ops,
-    }
+
+    if engine._pack:
+        choice = engine.pack_stats().get("winner") or {}
+        bs = int(choice.get("bs", PACKED_BS))
+        bd = int(choice.get("bd", PACKED_BD))
+        w_e, w_i = packed_words(t_e_raw), packed_words(t_i_raw)
+        kt_e, kt_i = w_e, w_i
+        ns_pad = -(-n_b // bs) * bs
+        nd_pad = -(-n_b // bd) * bd
+        n_i = ns_pad // bs
+        # int32 words: a_e/b_i per (q, src tile), b_e/a_i per src tile
+        hbm_bytes = 4 * q * n_i * (
+            bs * (lane_round_up(w_e + 1) + lane_round_up(w_i))
+            + nd_pad * (w_e + w_i + 2)
+        )
+        # contraction (1 AND + 1 OR per word pair) + the fused epilogue
+        vpu_cell_ops = q * ns_pad * nd_pad * (2 * (w_e + w_i) + 6)
+        comp = {
+            "hbm_s": hbm_bytes / hbm_bps,
+            "vpu_s": vpu_cell_ops / vpu_ops,
+        }
+        dtype = "packed32"
+    else:
+        dtype = os.environ.get("CYCLONUS_PALLAS_DTYPE", "int8")
+        kt_e, kt_i = _kt_for(t_e), _kt_for(t_i)
+        single = kt_e >= t_e and kt_i >= t_i
+        bs, bd = _tiles_for(
+            kt_e, kt_i, n_b,
+            single_chunk_int8=single and dtype == "int8",
+            n_dst=n_b,
+        )
+        ns_pad = -(-n_b // bs) * bs
+        nd_pad = -(-n_b // bd) * bd
+        n_i, n_j = ns_pad // bs, nd_pad // bd
+        opb = 2 if dtype == "bf16" else 1  # bytes per operand element
+        hbm_bytes = opb * q * n_i * (
+            bs * (kt_e + kt_i) + n_j * bd * (kt_e + kt_i)
+        )
+        mxu_ops = 2 * q * ns_pad * nd_pad * (kt_e + kt_i)
+        vpu_cell_ops = 6 * q * ns_pad * nd_pad
+        comp = {
+            "hbm_s": hbm_bytes / hbm_bps,
+            "mxu_s_dense": mxu_ops
+            / (mxu_int8 if dtype == "int8" else mxu_int8 / 2),
+            "vpu_s": vpu_cell_ops / vpu_ops,
+        }
     bound = max(comp, key=comp.get)
     roofline_s = comp[bound]
     return {
         "tile": [bs, bd],
         "kt": [kt_e, kt_i],
+        "dtype": dtype,
         "hbm_gb": round(hbm_bytes / 1e9, 3),
         **{k: round(v, 6) for k, v in comp.items()},
         "bound": bound,
@@ -712,9 +789,13 @@ def mesh_case(pods, namespaces, policies, cases) -> dict:
         # the HBM watermark acceptance: the overlapped schedule's peak
         # per-device peer-buffer bytes must undercut the all-gather
         # schedule's replicated peer copy once the mesh is real (>1 dev)
+        from cyclonus_tpu.engine.encoding import pack_enabled
+
         t = engine._tensors_with_cases(cases)
         t_padded, _ = sharded_mod._pad_pod_arrays(t, n, n_dev)
-        rb = sharded_mod.peer_buffer_bytes(t_padded, n_dev, "ring")
+        rb = sharded_mod.peer_buffer_bytes(
+            t_padded, n_dev, "ring", pack=pack_enabled()
+        )
         ab = sharded_mod.peer_buffer_bytes(t_padded, n_dev, "allgather")
         # the watermark acceptance holds from 8 devices up: the ring's
         # double-buffered bf16 bundle is ~4x(allgather bool bytes)/D, so
@@ -1511,6 +1592,32 @@ def _bench(done):
                     f"TILED COUNTS MISMATCH on sub-cluster {k}: "
                     f"counts={sub_counts[k]} kernel={v}"
                 )
+        # packed-vs-unpacked parity: the same sub-cluster through an
+        # engine with the CYCLONUS_PACK kill switch thrown must count
+        # identically — the in-bench leg of the packed differential
+        # gate (raises, never warns: wrong counts are never publishable)
+        if engine._pack:
+            _enter_phase("pack_parity")
+            saved_pack = os.environ.get("CYCLONUS_PACK")
+            os.environ["CYCLONUS_PACK"] = "0"
+            try:
+                unpacked_engine = TpuPolicyEngine(
+                    policy, sub_pods, namespaces
+                )
+                unpacked = unpacked_engine.evaluate_grid_counts(
+                    cases, block=100, backend="xla"
+                )
+            finally:
+                if saved_pack is None:
+                    os.environ.pop("CYCLONUS_PACK", None)
+                else:
+                    os.environ["CYCLONUS_PACK"] = saved_pack
+            for k, v in expected.items():
+                if unpacked[k] != v:
+                    raise AssertionError(
+                        f"PACKED PARITY MISMATCH on sub-cluster {k}: "
+                        f"packed={v} unpacked={unpacked[k]}"
+                    )
         allow_rate = counts["combined"] / max(cells, 1)
         # the production multi-chip fast path (tiled.py sharded +
         # kernel="pallas") Mosaic-compiles through shard_map here on a
@@ -1707,6 +1814,12 @@ def _bench(done):
                         # of HBM / MXU(dense) / VPU-epilogue binds, and
                         # how close the measured eval is to it
                         "roofline": roofline,
+                        # the bit-packed dtype plan: active flag, packed
+                        # word depths, tuned tile winner + autotune
+                        # search forensics (perfobs reads detail.pack on
+                        # every line; the sentinel gates roofline
+                        # efficiency on pack-bearing runs)
+                        "pack": _pack_detail(engine),
                         # the multi-chip sharded-pallas program Mosaic-
                         # compiled on a 1-device Mesh over the real chip
                         # (the compile path multi-chip would use), counts
@@ -1825,6 +1938,7 @@ def _bench(done):
                     "eval_s": round(t_eval, 4),
                     "allow_rate": round(allow_rate, 4),
                     "parity_spot_checks": n_samples,
+                    "pack": _pack_detail(engine),
                     "class_compression": engine.class_compression_stats(),
                     "mesh": mesh_detail,
                     "serve": serve_detail,

@@ -105,6 +105,74 @@ TIER_BANP = 1
 #: rank * 4 + action < 2^30 (ranks are slab positions, actions 1-3)
 TIER_KEY_NONE = 1 << 30
 
+# --- bit-packed match slabs (docs/DESIGN.md "Bit-packed kernel") ----------
+#
+# The verdict contraction is pure boolean: any_allow = OR_t (tmatch[t] AND
+# tallow[t]).  Packing the target axis 32-per-int32-word turns that OR of
+# T bools into an OR of ceil(T/32) word AND-OR steps — a 32x cut of the
+# contraction depth every evaluator shares (tiled bodies, the ring
+# bundles, the packed Pallas kernel).  int32 is the one packed dtype:
+# it is what api._pack_tensors ships, what Mosaic handles natively, and
+# the word sum below never carries across bit lanes, so the sign bit is
+# just bit 31.  The numpy packer here and the jnp twin
+# (kernel.pack_bool_words_jnp) are pinned bit-identical by
+# tests/test_engine_packed.py.
+
+#: bits per packed word — the 32-per-word layout every packed slab uses
+PACK_BITS = 32
+
+
+def packed_words(n: int) -> int:
+    """Words needed for `n` packed bits (>= 1): THE ceil-div round-up
+    shapelint SC004 discharges for packed-word axes, factored out like
+    pallas_kernel.lane_round_up so the 32-per-word arithmetic has one
+    formula."""
+    return -(-max(int(n), 1) // PACK_BITS)
+
+
+def pack_bool_words(a: np.ndarray, axis: int = 0) -> np.ndarray:
+    """Pack a bool array 32-per-word along `axis` into int32 words.
+
+    Bit b of word w holds element w * 32 + b (little-endian within the
+    word); the trailing word zero-pads.  Word values are built as a sum
+    of disjoint shifted bits, which equals the bitwise OR exactly (no
+    carries), including bit 31 riding the int32 sign."""
+    a = np.moveaxis(np.asarray(a, dtype=bool), axis, 0)
+    t = a.shape[0]
+    w = packed_words(t)
+    total = w * PACK_BITS  # tile: 32 — the 32-per-word round-up, SC004-proved
+    pad = total - t
+    if pad:
+        a = np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], dtype=bool)], axis=0
+        )
+    bits = a.reshape((w, PACK_BITS) + a.shape[1:]).astype(np.uint32)
+    shifts = (np.uint32(1) << np.arange(PACK_BITS, dtype=np.uint32)).reshape(
+        (1, PACK_BITS) + (1,) * (a.ndim - 1)
+    )
+    words = (bits * shifts).sum(axis=1, dtype=np.uint32).view(np.int32)
+    return np.moveaxis(words, 0, axis)
+
+
+def pack_enabled(mode: Optional[str] = None) -> bool:
+    """Resolve the CYCLONUS_PACK kill switch: "0" disables the packed
+    path everywhere (the pre-PR representation, bit-identical by the
+    packed parity suite); "1"/"auto" (default) enable it.  Resolved
+    EAGERLY at public entry points and passed as a static argument —
+    never read inside a traced function (the jit caches key on shapes
+    plus statics, so an env flip after tracing must retrace, not be
+    silently ignored; same discipline as CYCLONUS_PALLAS_DTYPE)."""
+    import os
+
+    if mode is None:
+        mode = os.environ.get("CYCLONUS_PACK", "auto")
+    mode = str(mode).lower()
+    if mode not in ("auto", "0", "1"):
+        raise ValueError(
+            f"CYCLONUS_PACK must be auto, 0, or 1, got {mode!r}"
+        )
+    return mode != "0"
+
 # protocols: TCP/UDP/SCTP preseeded; unknown protocol strings appearing in
 # policies get fresh ids at encode time so that equal strings still match
 # (the oracle compares protocol strings for equality — matcher/core.py).

@@ -36,6 +36,8 @@ from .encoding import (
     EXP_IN,
     EXP_NONE,
     EXP_NOT_IN,
+    PACK_BITS,
+    packed_words,
     NS_ALL,
     NS_EXACT,
     NS_SELECTOR,
@@ -229,6 +231,50 @@ def _bool_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+# --- bit-packed contraction (docs/DESIGN.md "Bit-packed kernel") ----------
+
+
+def pack_bool_words_jnp(a: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Device twin of encoding.pack_bool_words: pack a bool array
+    32-per-int32-word along `axis`.  Bit values are summed as disjoint
+    shifted powers of two — exactly the bitwise OR (no carries, bit 31
+    rides the int32 sign) — so the twins are bit-identical by
+    construction (pinned by tests/test_engine_packed.py)."""
+    a = jnp.moveaxis(a, axis, 0)
+    t = a.shape[0]
+    w = packed_words(t)
+    total = w * PACK_BITS  # tile: 32 — the 32-per-word round-up, SC004-proved
+    pad = total - t
+    if pad:
+        a = jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0
+        )
+    bits = a.reshape((w, PACK_BITS) + a.shape[1:]).astype(jnp.int32)
+    shifts = jax.lax.shift_left(
+        jnp.int32(1), jnp.arange(PACK_BITS, dtype=jnp.int32)
+    ).reshape((1, PACK_BITS) + (1,) * (a.ndim - 1))
+    words = jnp.sum(bits * shifts, axis=1, dtype=jnp.int32)
+    return jnp.moveaxis(words, 0, axis)
+
+
+def packed_any(a_pk: jnp.ndarray, b_pk: jnp.ndarray) -> jnp.ndarray:
+    """[A, B] bool: OR_w (a_pk[w, a] AND b_pk[w, b]) != 0 — the packed
+    twin of `_bool_matmul(a.T, b) over a [T, A] x [T, B] contraction`,
+    with the target axis pre-packed 32-per-word (a_pk [W, A], b_pk
+    [W, B] int32).  A lax.scan walks the W words sequentially with one
+    [A, B] int32 accumulator, so no [W, A, B] intermediate ever
+    materializes; W is ceil(T/32), which is what cuts the contraction
+    depth 32x vs the elementwise bool form."""
+
+    def body(acc, wab):
+        wa, wb = wab  # [A], [B]
+        return acc | (wa[:, None] & wb[None, :]), None
+
+    init = jnp.zeros((a_pk.shape[1], b_pk.shape[1]), dtype=jnp.int32)
+    acc, _ = jax.lax.scan(body, init, (a_pk, b_pk))
+    return acc != 0
+
+
 def m_tp_onehot(enc: Dict) -> jnp.ndarray:
     """[T, P] bool peer->target one-hot, built ON DEVICE from the [P]
     peer_target index vector.  The dense matrix reaches ~70 MB at the
@@ -246,15 +292,25 @@ def direction_allowed(
     m_tp: jnp.ndarray,  # [T, P] peer->target one-hot
     peer_match: jnp.ndarray,  # [P, Np] peer-side pods
     pport: jnp.ndarray,  # [P, Q]
+    pack: bool = False,
 ) -> jnp.ndarray:
     """[Nt, Np, Q] bool: direction verdict for (target-side pod, peer-side
-    pod, port case)."""
+    pod, port case).  With pack=True the dominant target-axis contraction
+    runs over 32-per-word packed bitmaps (packed_any) instead of the
+    bf16 matmul — bit-identical by construction, gated differentially by
+    the fuzz and packed parity suites."""
     n_p, n_np = peer_match.shape
     q = pport.shape[1]
     # peer_allow[P, Np*Q]
     peer_allow = (peer_match[:, :, None] & pport[:, None, :]).reshape(n_p, n_np * q)
     tallow = _bool_matmul(m_tp, peer_allow)  # [T, Np*Q]
-    any_allow = _bool_matmul(tmatch_target.T, tallow)  # [Nt, Np*Q]
+    if pack:
+        any_allow = packed_any(
+            pack_bool_words_jnp(tmatch_target),  # [W, Nt]
+            pack_bool_words_jnp(tallow),  # [W, Np*Q]
+        )
+    else:
+        any_allow = _bool_matmul(tmatch_target.T, tallow)  # [Nt, Np*Q]
     allowed = (~has_target[:, None]) | any_allow
     return allowed.reshape(-1, n_np, q)
 
@@ -412,15 +468,18 @@ def tier_direction_arrays(
     }
 
 
-@partial(jax.jit, static_argnames=())
-def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
+@partial(jax.jit, static_argnames=("pack",))
+def evaluate_grid_kernel(tensors: Dict, pack: bool = False) -> Dict[str, jnp.ndarray]:
     """Full-grid verdict on one device.
 
     tensors: pytree with keys
       sel_*: selector tables; pod_*: cluster pod arrays; ns_kv/ns_key;
       ingress/egress: per-direction encodings (dicts incl. peer_target);
       q_port/q_name/q_proto: [Q] port cases.
-    Returns ingress[q, d, s], egress[q, s, d], combined[q, s, d].
+    `pack` (static; resolved by the caller via encoding.pack_enabled)
+    routes the target-axis contraction through the 32-per-word packed
+    bitmaps.  Returns ingress[q, d, s], egress[q, s, d],
+    combined[q, s, d].
     """
     selpod = selector_match(
         tensors["sel_req_kv"],
@@ -463,7 +522,8 @@ def evaluate_grid_kernel(tensors: Dict) -> Dict[str, jnp.ndarray]:
             tensors["q_proto"],
         )
         out[direction] = direction_allowed(
-            pre["tmatch"], pre["has_target"], m_tp_onehot(enc), peer_match, pport
+            pre["tmatch"], pre["has_target"], m_tp_onehot(enc), peer_match,
+            pport, pack=pack,
         )
         if "tiers" in tensors:
             # precedence-tier resolution epilogue: same trace, one

@@ -626,6 +626,483 @@ def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# --- bit-packed kernel (docs/DESIGN.md "Bit-packed kernel") ---------------
+#
+# The verdict contraction is pure boolean, so the target axis packs
+# 32-per-int32-word (encoding.pack_bool_words): any_allow becomes an OR
+# over ceil(T/32) word AND steps instead of a depth-T matmul — a 32x cut
+# of the contraction depth and a 16x cut of the dominant operand bytes
+# vs bf16.  The whole packed depth fits ONE block at any realistic
+# target count (W <= 33 words for T <= 1024), so the kernel is always
+# single-chunk: word steps unroll statically and the matmul results
+# never leave registers before the epilogue.
+#
+# The contraction here is the popcount-style word form on the VPU — the
+# ISSUE's int8 MXU alternative is the existing dense int8 kernel, which
+# stays available as the CYCLONUS_PACK=0 dtype plan; the persisted
+# autotuner (engine/autotune.py) picks per shape bucket.
+#
+# FUSED EPILOGUES: the same body optionally resolves the precedence-
+# tier lattice (min-key first-match over scalar-prefetched rule keys —
+# previously only the XLA tile loop could evaluate tiered counts, with
+# the [c, A, B, Q] tier intermediates round-tripping HBM) and/or the
+# class-compression gather's dst-weighted row sums (previously a
+# separate einsum over materialized verdict blocks).  Everything stays
+# in VMEM between the contraction and the reduction.
+#
+# Layout rule of thumb: SRC-side per-pod operands put pods on the
+# SUBLANE axis and the packed-word/rule axis on the LANE axis
+# (128-rounded via lane_round_up, shapelint SC004); DST-side operands
+# put pods on the LANE axis.  Both slice [.., w:w+1] / [w:w+1, ..]
+# with STATIC w, so no dynamic relayouts reach Mosaic.  Per-side has/
+# valid flags ride ONE extra int32 word appended past the packed depth
+# (bit 0 = has_target, bit 1 = valid); the matching position of the
+# OTHER operand is structural zero padding, so the contraction loop —
+# which unrolls only the real words — never sees them.
+
+#: packed-kernel default tile heights (src x dst); the persisted
+#: autotuner searches over _PACKED_TILE_CANDIDATES per shape bucket
+PACKED_BS = 512
+PACKED_BD = 512
+
+#: the packed tile search space (engine/autotune.py candidates): every
+#: entry is bounded by the int32 partial-count rule bs * Nd' < 2^31,
+#: re-checked at call time
+PACKED_TILE_CANDIDATES = ((512, 512), (1024, 512), (2048, 1024))
+
+#: fused-tier unroll ceiling: the min-key loop unrolls statically over
+#: the bucketed rule rows, so a pathological ANP set must fall back to
+#: the XLA tile loop instead of tracing an unbounded program
+PACKED_TIER_MAX_ROWS = 1024
+
+
+def _sub8(n: int) -> int:
+    """Round up to the int32/f32 sublane tile (8)."""
+    return -(-max(int(n), 1) // 8) * 8
+
+
+def _sub32(n: int) -> int:
+    """Round up to the int8 sublane tile (32)."""
+    return -(-max(int(n), 1) // 32) * 32
+
+
+def _make_packed_kernel(
+    n_w_e: int, n_w_i: int, g_e: int, g_i: int, tiered: bool, weighted: bool
+):
+    """Packed single-chunk kernel body, specialized on the per-direction
+    word depths, the tier rule-row counts, and the epilogue variant.
+    Word and rule loops unroll statically (n_w <= ~33; g bounded by
+    PACKED_TIER_MAX_ROWS at the eligibility gate)."""
+    ti.KERNEL_TRACES.inc(
+        kernel="counts_packed"
+        + ("_tiered" if tiered else "")
+        + ("_weighted" if weighted else "")
+    )
+    from .encoding import TIER_KEY_NONE
+
+    def _kernel(*refs):
+        idx = 0
+        if tiered:
+            anp_e_ref, banp_e_ref, anp_i_ref, banp_i_ref = refs[:4]
+            idx = 4
+        a_e_ref = refs[idx]  # [BS, We_l] i32 — tmatch_e^T words + flags col
+        b_e_ref = refs[idx + 1]  # [1, We_s, BD] i32 — tallow_e words
+        b_i_ref = refs[idx + 2]  # [1, BS, Wi_l] i32 — tallow_i^T words
+        a_i_ref = refs[idx + 3]  # [Wi_s, BD] i32 — tmatch_i words + flags row
+        idx += 4
+        if tiered:
+            subj_e_ref = refs[idx]  # [BS, Ge_l] i8
+            peerq_e_ref = refs[idx + 1]  # [1, Ge_s, BD] i8
+            subj_i_ref = refs[idx + 2]  # [Gi_s, BD] i8
+            peerq_i_ref = refs[idx + 3]  # [1, BS, Gi_l] i8
+            idx += 4
+        if weighted:
+            w_ref = refs[idx]  # [8, BD] f32 (row 0 real)
+            idx += 1
+        out_ref = refs[idx]
+        acc_ref = refs[idx + 1]  # weighted: [BS, 128] f32; counts: [1, 128] i32
+
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        n_j = pl.num_programs(2)
+
+        if not weighted:
+            @pl.when((i == 0) & (j == 0))
+            def _init_out():
+                out_ref[:] = jnp.zeros_like(out_ref)
+
+        @pl.when(j == 0)
+        def _init_acc():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        # word-packed contraction, fully unrolled: the OR-accumulators
+        # live in registers straight into the epilogue
+        acc_e = a_e_ref[:, 0:1] & b_e_ref[0, 0:1, :]  # [BS, BD] i32
+        for w in range(1, n_w_e):
+            acc_e = acc_e | (a_e_ref[:, w : w + 1] & b_e_ref[0, w : w + 1, :])
+        acc_i = b_i_ref[0, :, 0:1] & a_i_ref[0:1, :]
+        for w in range(1, n_w_i):
+            acc_i = acc_i | (b_i_ref[0, :, w : w + 1] & a_i_ref[w : w + 1, :])
+
+        # per-side flags ride one extra word past the packed depth
+        flags_s = a_e_ref[:, n_w_e : n_w_e + 1]  # [BS, 1] i32
+        flags_d = a_i_ref[n_w_i : n_w_i + 1, :]  # [1, BD] i32
+        has_s = (flags_s & 1) != 0
+        valid_s = (flags_s & 2) != 0
+        has_d = (flags_d & 1) != 0
+        valid_d = (flags_d & 2) != 0
+
+        egress = (~has_s) | (acc_e != 0)  # [BS, BD]
+        ingress = (~has_d) | (acc_i != 0)
+
+        if tiered:
+            # fused tier min-key first-match epilogue: the same fold as
+            # kernel.tier_first_match_keys, with rule keys read from
+            # scalar prefetch and the [g, BS, BD] intermediates never
+            # leaving registers (the HBM round trip this fusion kills)
+            none = jnp.int32(TIER_KEY_NONE)
+            anp_e = jnp.full(egress.shape, none, dtype=jnp.int32)
+            banp_e = jnp.full(egress.shape, none, dtype=jnp.int32)
+            for g in range(g_e):
+                m = (subj_e_ref[:, g : g + 1] & peerq_e_ref[0, g : g + 1, :]) != 0
+                anp_e = jnp.minimum(anp_e, jnp.where(m, anp_e_ref[g], none))
+                banp_e = jnp.minimum(banp_e, jnp.where(m, banp_e_ref[g], none))
+            egress = resolve_tier_lattice_packed(egress, has_s, anp_e, banp_e)
+            anp_i = jnp.full(ingress.shape, none, dtype=jnp.int32)
+            banp_i = jnp.full(ingress.shape, none, dtype=jnp.int32)
+            for g in range(g_i):
+                # ingress subjects are the DST pods, peers the SRC pods
+                m = (peerq_i_ref[0, :, g : g + 1] & subj_i_ref[g : g + 1, :]) != 0
+                anp_i = jnp.minimum(anp_i, jnp.where(m, anp_i_ref[g], none))
+                banp_i = jnp.minimum(banp_i, jnp.where(m, banp_i_ref[g], none))
+            ingress = resolve_tier_lattice_packed(ingress, has_d, anp_i, banp_i)
+
+        combined = egress & ingress
+
+        if weighted:
+            # fused class-compression gather epilogue: dst-weighted row
+            # sums (tiled._class_tile_rowsums' einsum) computed in VMEM.
+            # Full-f32 VPU multiply-accumulate — exact for integer row
+            # sums < 2^24, the same bound the split path's HIGHEST-
+            # precision einsum holds (pad classes carry weight 0).
+            wrow = w_ref[0:1, :]  # [1, BD] f32
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+            rs = (
+                jnp.where(
+                    lane == 0,
+                    jnp.sum(ingress.astype(jnp.float32) * wrow, axis=1,
+                            keepdims=True),
+                    0.0,
+                )
+                + jnp.where(
+                    lane == 1,
+                    jnp.sum(egress.astype(jnp.float32) * wrow, axis=1,
+                            keepdims=True),
+                    0.0,
+                )
+                + jnp.where(
+                    lane == 2,
+                    jnp.sum(combined.astype(jnp.float32) * wrow, axis=1,
+                            keepdims=True),
+                    0.0,
+                )
+            )  # [BS, 128]
+            acc_ref[:] += rs
+
+            @pl.when(j == n_j - 1)
+            def _flush_rs():
+                out_ref[:] = acc_ref[:].reshape(1, *acc_ref.shape)
+        else:
+            mask = valid_s & valid_d
+            c_in = jnp.sum((ingress & mask).astype(jnp.int32))
+            c_eg = jnp.sum((egress & mask).astype(jnp.int32))
+            c_co = jnp.sum((combined & mask).astype(jnp.int32))
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+            acc_ref[:] += (
+                jnp.where(lane == 0, c_in, 0)
+                + jnp.where(lane == 1, c_eg, 0)
+                + jnp.where(lane == 2, c_co, 0)
+            )
+
+            @pl.when(j == n_j - 1)
+            def _flush():
+                out_ref[:, pl.ds(i, 1), :] = acc_ref[:].reshape(1, 1, 128)
+
+    return _kernel
+
+
+def resolve_tier_lattice_packed(np_allowed, has_b, anp_min, banp_min):
+    """The tier lattice fold, kernel-local twin of
+    kernel.resolve_tier_lattice (pure jnp, safe inside a Pallas body;
+    re-implemented here to keep this module import-light and the
+    constants explicit).  Bit-identity with the XLA fold is pinned by
+    the fused-vs-split parity tests."""
+    from .encoding import (
+        TIER_ACT_ALLOW,
+        TIER_ACT_NONE,
+        TIER_ACT_PASS,
+        TIER_KEY_NONE,
+    )
+
+    anp_act = jnp.where(anp_min < TIER_KEY_NONE, anp_min % 4, TIER_ACT_NONE)
+    banp_act = jnp.where(banp_min < TIER_KEY_NONE, banp_min % 4, TIER_ACT_NONE)
+    below = jnp.where(
+        has_b,
+        np_allowed,
+        jnp.where(
+            banp_act == TIER_ACT_NONE, True, banp_act == TIER_ACT_ALLOW
+        ),
+    )
+    return jnp.where(
+        (anp_act == TIER_ACT_NONE) | (anp_act == TIER_ACT_PASS),
+        below,
+        anp_act == TIER_ACT_ALLOW,
+    )
+
+
+def packed_tier_eligible(tensors: Dict) -> bool:
+    """THE host-side gate for the fused tier epilogue — the min-key
+    loop unrolls statically over the bucketed rule rows, so an
+    adversarial rule count must route to the XLA tile loop instead.
+    One implementation on purpose: both the dense counts route
+    (api._packed_tier_ok) and the fused class-counts route
+    (tiled.evaluate_grid_counts_classes) consult it, so the ceiling
+    cannot drift between them.  `tensors` is an engine tensor dict
+    (the bucketed tier action slabs carry the row counts)."""
+    if "tiers" not in tensors:
+        return True
+    rows = sum(
+        int(tensors["tiers"][d]["action"].shape[0])
+        for d in ("ingress", "egress")
+    )
+    return rows <= PACKED_TIER_MAX_ROWS
+
+
+def verdict_counts_pallas_packed(
+    tmatch_e_pk: jnp.ndarray,  # [We, Ns] int32 — packed egress tmatch
+    has_e: jnp.ndarray,  # [Ns] bool
+    tallow_e_pk: jnp.ndarray,  # [We, Nd, Q] int32 — packed egress tallow
+    tmatch_i_pk: jnp.ndarray,  # [Wi, Nd] int32
+    has_i: jnp.ndarray,  # [Nd] bool
+    tallow_i_pk: jnp.ndarray,  # [Wi, Ns, Q] int32
+    n_pods: int | jnp.ndarray = None,
+    valid_src: jnp.ndarray = None,
+    valid_dst: jnp.ndarray = None,
+    tier: Dict = None,
+    w_dst: jnp.ndarray = None,
+    bs: int = None,
+    bd: int = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The packed verdict kernel over pre-packed operands
+    (tiled._precompute(pack=True)).
+
+    Returns [Q, n_src_tiles, 3] int32 partial counts, or — with `w_dst`
+    (the class-size weights of the fused class-compression gather) —
+    [Q, Ns_pad, 3] f32 dst-weighted row sums.  `tier` fuses the
+    precedence-tier min-key epilogue ({direction: {subj, peerq,
+    anp_key, banp_key}} from the tiled precompute).  RECTANGULAR like
+    verdict_counts_pallas_rect: per-side validity masks, so the mesh
+    paths run it per device shard.  Semantics mirror the XLA tile body
+    exactly (explicit ~has OR and validity-masked counts — no
+    pseudo-target row), which is what the packed parity suite pins."""
+    ns = tmatch_e_pk.shape[1]
+    nd = tmatch_i_pk.shape[1]
+    if valid_src is None:
+        n32 = ns if n_pods is None else n_pods
+        valid_src = jnp.arange(ns) < n32
+    if valid_dst is None:
+        n32 = nd if n_pods is None else n_pods
+        valid_dst = jnp.arange(nd) < n32
+    return _verdict_counts_pallas_packed(
+        tmatch_e_pk, has_e, tallow_e_pk, tmatch_i_pk, has_i, tallow_i_pk,
+        valid_src, valid_dst, tier, w_dst,
+        bs=bs if bs is not None else PACKED_BS,
+        bd=bd if bd is not None else PACKED_BD,
+        interpret=interpret,
+    )
+
+
+@partial(jax.jit, static_argnames=("bs", "bd", "interpret"))
+def _verdict_counts_pallas_packed(
+    tmatch_e_pk, has_e, tallow_e_pk, tmatch_i_pk, has_i, tallow_i_pk,
+    valid_src, valid_dst, tier, w_dst, bs, bd, interpret,
+):
+    we = tmatch_e_pk.shape[0]
+    wi = tmatch_i_pk.shape[0]
+    q = tallow_e_pk.shape[2]
+
+    # mask invalid pod columns out of every packed operand (an arbitrary
+    # rect validity mask may invalidate REAL pods, and a pad column must
+    # contribute nothing to either contraction)
+    vs = valid_src[None, :]
+    vd = valid_dst[None, :]
+    tm_e = jnp.where(vs, tmatch_e_pk, 0)
+    tm_i = jnp.where(vd, tmatch_i_pk, 0)
+    tl_e = jnp.where(vd[:, :, None], tallow_e_pk, 0)
+    tl_i = jnp.where(vs[:, :, None], tallow_i_pk, 0)
+
+    # per-side flags words (bit 0 = has_target, bit 1 = valid)
+    flags_s = has_e.astype(jnp.int32) + 2 * valid_src.astype(jnp.int32)
+    flags_d = has_i.astype(jnp.int32) + 2 * valid_dst.astype(jnp.int32)
+
+    we_l = lane_round_up(we + 1)  # tile: 128 — flags col at index we
+    wi_l = lane_round_up(wi)  # tile: 128
+    we_s = _sub8(we)
+    wi_s = _sub8(wi + 1)  # flags row at index wi
+
+    a_e = jnp.concatenate([tm_e.T, flags_s[:, None]], axis=1)  # [Ns, We+1]
+    a_e = _pad_to(_pad_to(a_e, 1, we_l), 0, bs)  # [Ns', We_l]
+    b_e = _pad_to(
+        _pad_to(jnp.moveaxis(tl_e, 2, 0), 1, we_s), 2, bd
+    )  # [Q, We_s, Nd']
+    b_i = _pad_to(
+        _pad_to(jnp.transpose(tl_i, (2, 1, 0)), 1, bs), 2, wi_l
+    )  # [Q, Ns', Wi_l]
+    a_i = jnp.concatenate([tm_i, flags_d[None, :]], axis=0)  # [Wi+1, Nd]
+    a_i = _pad_to(_pad_to(a_i, 0, wi_s), 1, bd)  # [Wi_s, Nd']
+
+    ns_pad = a_e.shape[0]
+    nd_pad = a_i.shape[1]
+    n_i = ns_pad // bs
+    n_j = nd_pad // bd
+    if bs * nd_pad >= 2**31:
+        raise ValueError(
+            f"dst axis {nd_pad} too large for int32 tile counts at bs={bs}"
+        )
+
+    # structure, not value: jit retraces per pytree structure, so the
+    # None checks are static at trace time
+    tiered = tier is not None  # jaxlint: ignore[JX002]
+    weighted = w_dst is not None  # jaxlint: ignore[JX002]
+    g_e = int(tier["egress"]["subj"].shape[0]) if tiered else 0  # jaxlint: ignore[JX002]
+    g_i = int(tier["ingress"]["subj"].shape[0]) if tiered else 0  # jaxlint: ignore[JX002]
+
+    # (block shape, plain (q, i, j) index map) pairs; materialized as
+    # BlockSpecs per grid-spec flavor below (the prefetch flavor's maps
+    # take trailing scalar refs the packed maps ignore)
+    operands = [a_e, b_e, b_i, a_i]
+    blocks = [
+        ((bs, we_l), lambda q, i, j: (i, 0)),
+        ((1, we_s, bd), lambda q, i, j: (q, 0, j)),
+        ((1, bs, wi_l), lambda q, i, j: (q, i, 0)),
+        ((wi_s, bd), lambda q, i, j: (0, j)),
+    ]
+    prefetch = []
+    if tiered:  # jaxlint: ignore[JX002] — static structure branch
+        te, ti_ = tier["egress"], tier["ingress"]
+        ge_l = lane_round_up(g_e)  # tile: 128
+        ge_s = _sub32(g_e)
+        gi_l = lane_round_up(g_i)  # tile: 128
+        gi_s = _sub32(g_i)
+        subj_e = _pad_to(
+            _pad_to(
+                jnp.where(vs, te["subj"], False).T.astype(jnp.int8), 1, ge_l
+            ),
+            0,
+            bs,
+        )  # [Ns', Ge_l]
+        peerq_e = _pad_to(
+            _pad_to(
+                jnp.moveaxis(
+                    (te["peerq"] & vd[:, :, None]).astype(jnp.int8), 2, 0
+                ),
+                1,
+                ge_s,
+            ),
+            2,
+            bd,
+        )  # [Q, Ge_s, Nd']
+        subj_i = _pad_to(
+            _pad_to(
+                jnp.where(vd, ti_["subj"], False).astype(jnp.int8), 0, gi_s
+            ),
+            1,
+            bd,
+        )  # [Gi_s, Nd']
+        peerq_i = _pad_to(
+            _pad_to(
+                jnp.transpose(
+                    (ti_["peerq"] & vs[:, :, None]).astype(jnp.int8),
+                    (2, 1, 0),
+                ),
+                1,
+                bs,
+            ),
+            2,
+            gi_l,
+        )  # [Q, Ns', Gi_l]
+        operands += [subj_e, peerq_e, subj_i, peerq_i]
+        blocks += [
+            ((bs, ge_l), lambda q, i, j: (i, 0)),
+            ((1, ge_s, bd), lambda q, i, j: (q, 0, j)),
+            ((gi_s, bd), lambda q, i, j: (0, j)),
+            ((1, bs, gi_l), lambda q, i, j: (q, i, 0)),
+        ]
+        prefetch = [
+            te["anp_key"].astype(jnp.int32),
+            te["banp_key"].astype(jnp.int32),
+            ti_["anp_key"].astype(jnp.int32),
+            ti_["banp_key"].astype(jnp.int32),
+        ]
+    if weighted:  # jaxlint: ignore[JX002] — static structure branch
+        w8 = jnp.zeros((8, nd_pad), dtype=jnp.float32)
+        w8 = w8.at[0, : w_dst.shape[0]].set(w_dst.astype(jnp.float32))
+        operands.append(w8)
+        blocks.append(((8, bd), lambda q, i, j: (0, j)))
+
+    kernel = _make_packed_kernel(we, wi, g_e, g_i, tiered, weighted)
+    if weighted:  # jaxlint: ignore[JX002] — static structure branch
+        out_block = ((1, bs, 128), lambda q, i, j: (q, i, 0))
+        out_shape = jax.ShapeDtypeStruct((q, ns_pad, 128), jnp.float32)
+        scratch = [pltpu.VMEM((bs, 128), jnp.float32)]
+    else:
+        out_block = ((1, n_i, 128), lambda q, i, j: (q, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((q, n_i, 128), jnp.int32)
+        scratch = [pltpu.VMEM((1, 128), jnp.int32)]
+    cost = pl.CostEstimate(
+        flops=2 * q * ns_pad * nd_pad * (we + wi + g_e + g_i + 3),
+        bytes_accessed=4 * q * n_i * (bs * we_l + nd_pad * (we_s + wi_s))
+        + 4 * q * n_i * bs * wi_l,
+        transcendentals=0,
+    )
+    if tiered:  # jaxlint: ignore[JX002] — static structure branch
+
+        def _with_prefetch(m):
+            # grid indices first, then one ref per scalar-prefetch
+            # operand (ignored by the packed maps)
+            return lambda q, i, j, *refs, _m=m: _m(q, i, j)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(q, n_i, n_j),
+            in_specs=[
+                pl.BlockSpec(shape, _with_prefetch(m)) for shape, m in blocks
+            ],
+            out_specs=pl.BlockSpec(out_block[0], _with_prefetch(out_block[1])),
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            cost_estimate=cost,
+            interpret=interpret,
+        )(*prefetch, *operands)
+    else:
+        out = pl.pallas_call(
+            kernel,
+            grid=(q, n_i, n_j),
+            in_specs=[pl.BlockSpec(shape, m) for shape, m in blocks],
+            out_specs=pl.BlockSpec(*out_block),
+            scratch_shapes=scratch,
+            out_shape=out_shape,
+            cost_estimate=cost,
+            interpret=interpret,
+        )(*operands)
+    return out[:, :, :3]
+
+
 # --- per-tile target slabs -------------------------------------------------
 #
 # The single-chunk kernel contracts EVERY tile pair over the full live

@@ -316,7 +316,7 @@ def _sharded_eval(tensors: Dict) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
     return ingress_rows, egress, combined
 
 
-def _ring_grid_eval(tensors: Dict, n_dev: int, shard: int):
+def _ring_grid_eval(tensors: Dict, n_dev: int, shard: int, pack: bool = False):
     """The per-device OVERLAPPED ring program: local peer-side bundle
     only, one ppermute hop per step, verdict blocks written column-wise.
 
@@ -325,7 +325,10 @@ def _ring_grid_eval(tensors: Dict, n_dev: int, shard: int):
     step's semantics — including the precedence-tier epilogue, whose
     min-key resolution runs INSIDE each ring step against the rotated
     subject/peer blocks — can never diverge from the single-device and
-    ring-counts paths."""
+    ring-counts paths.  With `pack` the rotating bundle carries the
+    32-per-word packed match slabs (tiled._split_pre), so each ppermute
+    hop moves ~16x fewer peer bytes; the allgather schedule stays the
+    dense reference twin the ring is pinned bit-identical against."""
     from .tiled import (
         _dst_bundle_keys,
         _precompute,
@@ -334,7 +337,7 @@ def _ring_grid_eval(tensors: Dict, n_dev: int, shard: int):
         _tile_verdicts_split,
     )
 
-    pre = _precompute(tensors)
+    pre = _precompute(tensors, pack)
     src, dst0 = _split_pre(pre)
     dev = jax.lax.axis_index("x")
     n_total = n_dev * shard
@@ -372,7 +375,7 @@ def mesh_schedule(schedule: Optional[str] = None) -> str:
 
 
 def peer_buffer_bytes(
-    tensors: Dict, n_dev: int, schedule: str
+    tensors: Dict, n_dev: int, schedule: str, pack: bool = False
 ) -> int:
     """Host-side estimate of the PER-DEVICE peer-side working set of one
     sharded grid eval — the number the HBM watermark gauge records and
@@ -382,7 +385,11 @@ def peer_buffer_bytes(
     egress tallow [T_e, N, Q] + ingress tmatch [T_i, N] + has [N]
     (+ the gathered tier scope blocks).  ring: TWO copies (resident +
     in-flight ppermute target) of the rotating bundle over one shard —
-    tallow_bf is bf16 (2 bytes), the rest bool."""
+    tallow_bf is bf16 (2 bytes), the rest bool; with `pack` the
+    tallow/tmatch legs ship as 32-per-word int32 packed slabs
+    (encoding.packed_words(T) words of 4 bytes each)."""
+    from .encoding import packed_words
+
     n = int(tensors["pod_ns_id"].shape[0])
     q = int(tensors["q_port"].shape[0])
     t_e = int(tensors["egress"]["target_ns"].shape[0])
@@ -394,13 +401,22 @@ def peer_buffer_bytes(
     if schedule == "allgather":
         return t_e * n * q + t_i * n + n + g_e * n * q + g_i * n
     shard = n // max(n_dev, 1)
-    bundle = (
-        2 * t_e * shard * q  # tallow_bf: bf16
-        + t_i * shard
-        + shard  # has_i
-        + g_e * shard * q
-        + g_i * shard
-    )
+    if pack:
+        bundle = (
+            4 * packed_words(t_e) * shard * q  # tallow_pk: int32 words
+            + 4 * packed_words(t_i) * shard  # tmatch_pk
+            + shard  # has_i
+            + g_e * shard * q
+            + g_i * shard
+        )
+    else:
+        bundle = (
+            2 * t_e * shard * q  # tallow_bf: bf16
+            + t_i * shard
+            + shard  # has_i
+            + g_e * shard * q
+            + g_i * shard
+        )
     return 2 * bundle
 
 
@@ -413,7 +429,9 @@ _SHARDED_PROGRAMS: Dict = {}
 _SHARDED_PROGRAMS_MAX = 64
 
 
-def _sharded_program(mesh: Mesh, schedule: str, shard: int, in_specs: Dict):
+def _sharded_program(
+    mesh: Mesh, schedule: str, shard: int, in_specs: Dict, pack: bool = False
+):
     n_dev = int(mesh.devices.size)
     leaves, treedef = jax.tree_util.tree_flatten(in_specs)
     key = (
@@ -421,6 +439,7 @@ def _sharded_program(mesh: Mesh, schedule: str, shard: int, in_specs: Dict):
         tuple(mesh.axis_names),
         schedule,
         shard,
+        pack,
         treedef,
         tuple(leaves),
     )
@@ -432,8 +451,8 @@ def _sharded_program(mesh: Mesh, schedule: str, shard: int, in_specs: Dict):
             P("x", None, None),
         )
         if schedule == "ring":
-            def body(t, _n_dev=n_dev, _shard=shard):
-                return _ring_grid_eval(t, _n_dev, _shard)
+            def body(t, _n_dev=n_dev, _shard=shard, _pack=pack):
+                return _ring_grid_eval(t, _n_dev, _shard, _pack)
         else:
             body = _sharded_eval
         fn = jax.jit(
@@ -487,16 +506,20 @@ def evaluate_grid_sharded(
     "ring" (overlapped, default) or "allgather" (replicated reference);
     both are bit-identical by construction and pinned so by
     tests/test_engine_sharded.py."""
+    from .encoding import pack_enabled
+
     mesh = mesh or default_mesh()
     schedule = mesh_schedule(schedule)
+    pack = pack_enabled()
     n_dev = mesh.devices.size
     tensors, padded_n = _pad_pod_arrays(tensors, n_pods, n_dev)
     shard = padded_n // n_dev
 
     in_specs = pod_sharded_in_specs(tensors)
-    fn = _sharded_program(mesh, schedule, shard, in_specs)
+    fn = _sharded_program(mesh, schedule, shard, in_specs, pack=pack)
     ti.MESH_PEER_BYTES.set(
-        peer_buffer_bytes(tensors, n_dev, schedule), schedule=schedule
+        peer_buffer_bytes(tensors, n_dev, schedule, pack=pack),
+        schedule=schedule,
     )
     with ti.eval_flight(
         "grid.sharded", n_pods, int(tensors["q_port"].shape[0]),

@@ -52,10 +52,12 @@ from jax.sharding import PartitionSpec as P
 
 from ..telemetry import instruments as ti
 from ..utils.tracing import phase
-from .encoding import TIER_KEY_NONE
+from .encoding import TIER_KEY_NONE, pack_enabled
 from .kernel import (
     direction_precompute,
     m_tp_onehot,
+    pack_bool_words_jnp,
+    packed_any,
     port_spec_allows,
     resolve_tier_lattice,
     selector_match,
@@ -73,13 +75,23 @@ def _apply_host_ip(enc: Dict, pre: Dict) -> Dict:
     return pre
 
 
-def _precompute(tensors: Dict) -> Dict[str, Dict[str, jnp.ndarray]]:
+def _precompute(
+    tensors: Dict, pack: bool = False
+) -> Dict[str, Dict[str, jnp.ndarray]]:
     """Per-direction, port-resolved precompute shared by every tile:
 
       tallow_bf [T, N, Q] bf16 — target t allows traffic with pod n on the
                                  PEER side for port case q (m_tp @ peer_allow)
       tmatch    [T, N] bool    — target t applies to pod n (target side)
       has_target[N] bool
+
+    With pack=True (static; docs/DESIGN.md "Bit-packed kernel") the
+    target-axis operands ship 32-per-word instead: tallow_pk [W, N, Q]
+    int32 and tmatch_pk [W, N] int32 REPLACE tallow_bf (W =
+    encoding.packed_words(T)) — 16x fewer peer-bundle bytes on the ring
+    and a 32x shallower contraction in every tile body.  The bool
+    tmatch/has_target stay (they are small and the count masks and slab
+    plan read them).
     """
     selpod = selector_match(
         tensors["sel_req_kv"],
@@ -127,10 +139,21 @@ def _precompute(tensors: Dict) -> Dict[str, Dict[str, jnp.ndarray]]:
         )
         t = tallow.shape[0]
         out[direction] = {
-            "tallow_bf": (tallow > 0).astype(jnp.bfloat16).reshape(t, n, q),
             "tmatch": pre["tmatch"],
             "has_target": pre["has_target"],
         }
+        if pack:
+            tallow_b = (tallow > 0).reshape(t, n, q)
+            out[direction]["tallow_pk"] = pack_bool_words_jnp(
+                tallow_b
+            )  # shape: (W, N, Q) int32
+            out[direction]["tmatch_pk"] = pack_bool_words_jnp(
+                pre["tmatch"]
+            )  # shape: (W, N) int32
+        else:
+            out[direction]["tallow_bf"] = (
+                (tallow > 0).astype(jnp.bfloat16).reshape(t, n, q)
+            )
         if "tiers" in tensors:
             # precedence-tier precompute (docs/DESIGN.md "Precedence
             # tiers"): subj/peerq/keys ride next to tallow so every tile
@@ -196,17 +219,36 @@ def _split_pre(pre: Dict) -> Tuple[Dict, Dict]:
     device both views slice the same arrays; in the ring path the dst
     view is the rotating remote shard.  Tier arrays split the same way:
     subjects sit on the direction's target side, peerq on its peer side;
-    the [G] key vectors are pod-independent and stay in the src view."""
-    src = {
-        "tmatch_e": pre["egress"]["tmatch"],
-        "has_e": pre["egress"]["has_target"],
-        "tallow_i": pre["ingress"]["tallow_bf"],
-    }
-    dst = {
-        "tallow_e": pre["egress"]["tallow_bf"],
-        "tmatch_i": pre["ingress"]["tmatch"],
-        "has_i": pre["ingress"]["has_target"],
-    }
+    the [G] key vectors are pod-independent and stay in the src view.
+
+    The canonical view KEYS are representation-independent: with the
+    packed precompute (tallow_pk/tmatch_pk present) the same names carry
+    the int32 packed words — the bundle specs and ring schedules are
+    shape-pattern-identical, and _tile_verdicts_split picks the
+    contraction by dtype.  The packed bundle is what rides the ppermute
+    ring: ~16x fewer peer bytes per hop than the bf16 tallow."""
+    if "tallow_pk" in pre["egress"]:
+        src = {
+            "tmatch_e": pre["egress"]["tmatch_pk"],
+            "has_e": pre["egress"]["has_target"],
+            "tallow_i": pre["ingress"]["tallow_pk"],
+        }
+        dst = {
+            "tallow_e": pre["egress"]["tallow_pk"],
+            "tmatch_i": pre["ingress"]["tmatch_pk"],
+            "has_i": pre["ingress"]["has_target"],
+        }
+    else:
+        src = {
+            "tmatch_e": pre["egress"]["tmatch"],
+            "has_e": pre["egress"]["has_target"],
+            "tallow_i": pre["ingress"]["tallow_bf"],
+        }
+        dst = {
+            "tallow_e": pre["egress"]["tallow_bf"],
+            "tmatch_i": pre["ingress"]["tmatch"],
+            "has_i": pre["ingress"]["has_target"],
+        }
     if "tier" in pre["egress"]:
         te, ti_ = pre["egress"]["tier"], pre["ingress"]["tier"]
         src["tier_subj_e"] = te["subj"]
@@ -226,35 +268,48 @@ def _tile_verdicts_split(
     each [B, Nd, Q] bool; ingress_rows[b, d, q] = ingress verdict for
     dst d <- src (start+b).  THE per-tile verdict body — every tiled
     path (single-device, mesh-parallel, ring) goes through here so the
-    semantics cannot diverge."""
+    semantics cannot diverge.  The contraction is picked by the view
+    REPRESENTATION (_split_pre): int32 views are 32-per-word packed
+    bitmaps contracted with packed_any; bool/bf16 views keep the bf16
+    matmul.  Both forms are exact on 0/1 values, pinned bit-identical
+    by the packed parity suite."""
     t_e, nd, q = dst["tallow_e"].shape
     t_i = dst["tmatch_i"].shape[0]
+    packed = src["tmatch_e"].dtype == jnp.int32
 
     # egress: the source block is the TARGET side; peer side = dst pods
     tme = jax.lax.dynamic_slice(src["tmatch_e"], (0, start), (t_e, block))
     hte = jax.lax.dynamic_slice(src["has_e"], (start,), (block,))  # [B]
-    any_e = (
-        jnp.matmul(
-            tme.T.astype(jnp.bfloat16),
-            dst["tallow_e"].reshape(t_e, nd * q),
-            preferred_element_type=jnp.bfloat16,
+    if packed:
+        any_e = packed_any(tme, dst["tallow_e"].reshape(t_e, nd * q))
+    else:
+        any_e = (
+            jnp.matmul(
+                tme.T.astype(jnp.bfloat16),
+                dst["tallow_e"].reshape(t_e, nd * q),
+                preferred_element_type=jnp.bfloat16,
+            )
+            > 0
         )
-        > 0
-    ).reshape(block, nd, q)
+    any_e = any_e.reshape(block, nd, q)
     egress = (~hte[:, None, None]) | any_e  # [B, Nd, Q]
 
     # ingress: the source block is the PEER side; target side = dst pods
     tli = jax.lax.dynamic_slice(
         src["tallow_i"], (0, start, 0), (t_i, block, q)
     )  # [T, B, Q]
-    any_i = (
-        jnp.matmul(
-            dst["tmatch_i"].T.astype(jnp.bfloat16),
-            tli.reshape(t_i, block * q),
-            preferred_element_type=jnp.bfloat16,
+    if packed:
+        any_i = packed_any(dst["tmatch_i"], tli.reshape(t_i, block * q))
+    else:
+        any_i = (
+            jnp.matmul(
+                dst["tmatch_i"].T.astype(jnp.bfloat16),
+                tli.reshape(t_i, block * q),
+                preferred_element_type=jnp.bfloat16,
+            )
+            > 0
         )
-        > 0
-    ).reshape(nd, block, q)
+    any_i = any_i.reshape(nd, block, q)
     ingress_t = (~dst["has_i"][:, None, None]) | any_i  # [Nd, B, Q]
 
     if "tier_subj_e" in src:
@@ -348,14 +403,14 @@ def _int32_safe_block(block: int, n_pods: int, q: int) -> int:
     return block
 
 
-@partial(jax.jit, static_argnames=("block", "n_tiles", "n_pods"))
+@partial(jax.jit, static_argnames=("block", "n_tiles", "n_pods", "pack"))
 def _counts_kernel(
-    tensors: Dict, block: int, n_tiles: int, n_pods: int
+    tensors: Dict, block: int, n_tiles: int, n_pods: int, pack: bool = False
 ) -> jnp.ndarray:
     """[n_tiles, 3] int32 allow counts (ingress, egress, combined) over the
     full grid, computed with one device execution; the host sums tiles in
     int64."""
-    pre = _precompute(tensors)
+    pre = _precompute(tensors, pack)
     n_padded = tensors["pod_ns_id"].shape[0]
     valid = jnp.arange(n_padded) < n_pods  # [N] pod-validity mask
 
@@ -367,10 +422,14 @@ def _counts_kernel(
 
 
 def evaluate_grid_counts(
-    tensors: Dict, n_pods: int, block: int = 1024
+    tensors: Dict, n_pods: int, block: int = 1024, pack: bool = None
 ) -> Dict[str, int]:
     """Allow counts over the full N x N x Q grid without materializing it.
-    One jit dispatch, one [n_tiles, 3] readback."""
+    One jit dispatch, one [n_tiles, 3] readback.  `pack` routes the tile
+    bodies through the 32-per-word packed operands (None: resolve
+    CYCLONUS_PACK eagerly here, outside the jit)."""
+    if pack is None:
+        pack = pack_enabled()
     q = int(tensors["q_port"].shape[0])
     # per-tile counts are int32: keep block * N * Q below 2^31 (the
     # equivalent global-accumulator overflow bit the pallas backend at
@@ -379,7 +438,7 @@ def evaluate_grid_counts(
     with ti.eval_flight("counts.xla", n_pods, q, block=block) as fl:
         tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
         with phase("engine.dispatch"):
-            out = _counts_kernel(tensors, block, n_tiles, n_pods)
+            out = _counts_kernel(tensors, block, n_tiles, n_pods, pack)
         # the readback is the execution barrier (dispatch is async)
         with phase("engine.execute"):
             counts = np.asarray(out, dtype=np.int64).sum(axis=0)
@@ -438,13 +497,13 @@ def _class_tile_rowsums(
     return jnp.stack([rs(ingress_rows), rs(egress), rs(combined)], axis=-1)
 
 
-@partial(jax.jit, static_argnames=("block", "n_tiles"))
+@partial(jax.jit, static_argnames=("block", "n_tiles", "pack"))
 def _class_rowsums_kernel(
-    tensors: Dict, w: jnp.ndarray, block: int, n_tiles: int
+    tensors: Dict, w: jnp.ndarray, block: int, n_tiles: int, pack: bool = False
 ) -> jnp.ndarray:
     """[n_tiles * block, Q, 3] f32 weighted row sums over the class grid,
     one device execution (fori_loop over class tiles)."""
-    pre = _precompute(tensors)
+    pre = _precompute(tensors, pack)
     src, dst = _split_pre(pre)
     q = tensors["q_port"].shape[0]
 
@@ -495,27 +554,100 @@ def class_counts_finish(
     }
 
 
+@partial(jax.jit, static_argnames=("interpret",))
+def _class_rowsums_fused_kernel(
+    tensors: Dict, w: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Fused-epilogue twin of _class_rowsums_kernel: packed precompute +
+    the packed Pallas kernel whose EPILOGUE computes the dst-weighted
+    row sums in VMEM (the class-compression gather's weighting never
+    round-trips a verdict block through HBM).  One jit: precompute +
+    kernel are one device execution.  Returns [Cb, Q, 3] f32 —
+    bit-identical to the split kernel by the fused-vs-split parity
+    test."""
+    from .pallas_kernel import verdict_counts_pallas_packed
+
+    pre = _precompute(tensors, True)
+    tier = {
+        d: pre[d]["tier"] for d in ("ingress", "egress")
+    } if "tier" in pre["egress"] else None
+    cb = int(tensors["pod_ns_id"].shape[0])
+    rs = verdict_counts_pallas_packed(
+        pre["egress"]["tmatch_pk"],
+        pre["egress"]["has_target"],
+        pre["egress"]["tallow_pk"],
+        pre["ingress"]["tmatch_pk"],
+        pre["ingress"]["has_target"],
+        pre["ingress"]["tallow_pk"],
+        n_pods=cb,  # every class row is live; pad weights are zero
+        tier=tier,
+        w_dst=w,
+        interpret=interpret,
+    )  # [Q, Cb', 3] f32
+    return jnp.moveaxis(rs[:, :cb, :], 0, 1)  # [Cb, Q, 3]
+
+
 def evaluate_grid_counts_classes(
     tensors: Dict,
     n_classes: int,
     class_size: np.ndarray,
     n_pods: int,
     block: int = 1024,
+    pack: bool = None,
+    kernel: str = None,
 ) -> Tuple[Dict[str, int], float]:
     """Allow counts over the FULL N x N x Q grid, evaluated on the
     compressed C x C class grid and weighted back exactly.  Returns
     (counts, gather_s) where gather_s is the broadcast-back epilogue
     (the host weighting) — the cheap gather the compression trades the
-    dense grid for."""
+    dense grid for.
+
+    kernel="pallas" (the TPU default when `pack` is on) runs the FUSED
+    packed kernel — contraction + tier lattice + the dst-weighted gather
+    epilogue in one Pallas program; kernel="xla" keeps the fori_loop
+    tile body.  Identical row sums by construction (the fused-vs-split
+    parity test pins them)."""
     import time as _time
 
+    from .pallas_kernel import packed_tier_eligible
+
+    if pack is None:
+        pack = pack_enabled()
+    if kernel is None:
+        # the same static-unroll ceiling the dense counts route
+        # enforces (api._packed_tier_ok): an oversized tier rule axis
+        # routes the class counts to the XLA tile loop too
+        kernel = (
+            "pallas"
+            if pack
+            and jax.default_backend() == "tpu"
+            and packed_tier_eligible(tensors)
+            else "xla"
+        )
+    if kernel not in ("pallas", "xla"):
+        raise ValueError(
+            f"unknown class counts kernel {kernel!r} (want 'pallas' or 'xla')"
+        )
+    if kernel == "pallas" and not packed_tier_eligible(tensors):
+        raise ValueError(
+            "class counts kernel 'pallas' cannot fuse a tier rule axis "
+            "past the static-unroll ceiling; use kernel='xla' or "
+            "kernel=None (auto)"
+        )
     q = int(tensors["q_port"].shape[0])
     w, block, n_tiles = class_rowsums_plan(tensors, n_classes, class_size, block)
     with ti.eval_flight(
         "counts.classes", n_pods, q, classes=n_classes, block=block
     ) as fl:
         with phase("engine.dispatch"):
-            out = _class_rowsums_kernel(tensors, w, block, n_tiles)
+            if kernel == "pallas":
+                from .pallas_kernel import _should_interpret
+
+                out = _class_rowsums_fused_kernel(
+                    tensors, w, interpret=_should_interpret()
+                )
+            else:
+                out = _class_rowsums_kernel(tensors, w, block, n_tiles, pack)
         # the readback is the execution barrier (dispatch is async)
         with phase("engine.execute"):
             rs = np.asarray(out)
@@ -548,6 +680,7 @@ def evaluate_grid_counts_classes_sharded(
     mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
         tensors, n_classes, block, mesh
     )
+    pack = pack_enabled()
     shard = n_padded // n_dev
     tiles_per_shard = shard // block
     w = np.zeros((n_padded,), dtype=np.float32)
@@ -557,7 +690,9 @@ def evaluate_grid_counts_classes_sharded(
 
     def per_device(td):
         w_all = td["class_w"]
-        pre = _precompute({k: v for k, v in td.items() if k != "class_w"})
+        pre = _precompute(
+            {k: v for k, v in td.items() if k != "class_w"}, pack
+        )
         src, dst = _split_pre(pre)
         dev = jax.lax.axis_index("x")
         row0 = dev * shard
@@ -602,16 +737,18 @@ def _block_kernel(pre: Dict, start: jnp.ndarray, block: int):
 
 
 def iter_grid_blocks(
-    tensors: Dict, n_pods: int, block: int = 1024
+    tensors: Dict, n_pods: int, block: int = 1024, pack: bool = None
 ) -> Iterator[Tuple[int, np.ndarray, np.ndarray, np.ndarray]]:
     """Stream verdict blocks to the host: yields
     (start, ingress_rows, egress, combined) with arrays [b, N, Q] bool,
     pad rows/columns already stripped.  ingress_rows[b, d, q] is the
     ingress verdict for dst d <- src (start+b) — i.e. full-grid
     ingress[q, d, start+b]."""
+    if pack is None:
+        pack = pack_enabled()
     block = min(block, max(n_pods, 1))
     tensors, n_tiles = _pad_pod_axis(tensors, n_pods, block)
-    pre = _precompute_jit(tensors)
+    pre = _precompute_jit(tensors, pack)
     # the pod axis may carry MORE pad rows than one block's worth (shape
     # bucketing pads before this function): iterate only the tiles with
     # real rows and clamp the final tile's height to the real pod count
@@ -630,7 +767,7 @@ def iter_grid_blocks(
         )
 
 
-_precompute_jit = jax.jit(_precompute)
+_precompute_jit = partial(jax.jit, static_argnames=("pack",))(_precompute)
 
 
 def _mesh_counts_setup(tensors: Dict, n_pods: int, block: int, mesh):
@@ -704,13 +841,16 @@ def evaluate_grid_counts_ring(
     mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
         tensors, n_pods, block, mesh
     )
+    pack = pack_enabled()
     shard = n_padded // n_dev
     tiles_per_shard = shard // block
 
     def per_device(t):
         # local precompute over THIS device's pod shard only (t's pod
-        # arrays arrive shard-sharded via in_specs)
-        pre = _precompute(t)
+        # arrays arrive shard-sharded via in_specs); with packing on the
+        # rotating dst bundle carries the packed words — the ppermute
+        # hop moves ~16x fewer bytes per step
+        pre = _precompute(t, pack)
         dev = jax.lax.axis_index("x")
         row0 = dev * shard
         valid_local = (jnp.arange(shard) + row0) < n_pods  # [shard]
@@ -761,11 +901,14 @@ def evaluate_grid_counts_ring(
 # so steady-state mesh evals dispatch only `step`, back to back, with one
 # readback (counts_pipelined_eval_s's discipline, on the mesh).
 
-#: shard_map specs of the src-side (local, non-rotating) precompute view
+#: shard_map specs of the src-side (local, non-rotating) precompute
+#: view.  Shape patterns are representation-independent: the packed
+#: plan carries int32 word slabs ([W, N]/[W, N, Q]) under the same
+#: keys and axis layout (_split_pre).
 _SRC_SPECS = {
-    "tmatch_e": P(None, "x"),  # shape: (T_e, N) bool
+    "tmatch_e": P(None, "x"),  # shape: (T_e, N) bool | (W_e, N) int32
     "has_e": P("x"),  # shape: (N,) bool
-    "tallow_i": P(None, "x", None),  # shape: (T_i, N, Q) bf16
+    "tallow_i": P(None, "x", None),  # (T_i, N, Q) bf16 | (W_i, N, Q) int32
     "tier_subj_e": P(None, "x"),  # shape: (G_e, N) bool
     "tier_peerq_i": P(None, "x", None),  # shape: (G_i, N, Q) bool
     "tier_keys_e": P(),  # shape: (2, G_e) int32 (replicated)
@@ -774,8 +917,8 @@ _SRC_SPECS = {
 #: shard_map specs of the rotating peer-side ring bundle (the arrays a
 #: ppermute hop moves; donated by the step program)
 _RING_SPECS = {
-    "tallow_e": P(None, "x", None),  # shape: (T_e, N, Q) bf16
-    "tmatch_i": P(None, "x"),  # shape: (T_i, N) bool
+    "tallow_e": P(None, "x", None),  # (T_e, N, Q) bf16 | (W_e, N, Q) int32
+    "tmatch_i": P(None, "x"),  # shape: (T_i, N) bool | (W_i, N) int32
     "has_i": P("x"),  # shape: (N,) bool
     "tier_peerq_e": P(None, "x", None),  # shape: (G_e, N, Q) bool
     "tier_subj_i": P(None, "x"),  # shape: (G_i, N) bool
@@ -794,6 +937,7 @@ def ring_counts_pipeline(tensors: Dict, n_pods: int, block: int, mesh):
     compiled pair."""
     from .sharded import pod_sharded_in_specs, shard_map_no_check
 
+    pack = pack_enabled()
     n_dev = int(mesh.devices.size)
     n_padded = int(tensors["pod_ns_id"].shape[0])
     shard = n_padded // n_dev
@@ -808,6 +952,7 @@ def ring_counts_pipeline(tensors: Dict, n_pods: int, block: int, mesh):
         block,
         n_pods,
         tiered,
+        pack,
         treedef,
         tuple(leaves),
     )
@@ -816,7 +961,7 @@ def ring_counts_pipeline(tensors: Dict, n_pods: int, block: int, mesh):
         return cached
 
     def seed_device(t):
-        pre = _precompute(t)
+        pre = _precompute(t, pack)
         src, dst0 = _split_pre(pre)
         dev = jax.lax.axis_index("x")
         valid = (jnp.arange(shard) + dev * shard) < n_pods
@@ -976,11 +1121,12 @@ def evaluate_grid_counts_ring2d(
         mesh.shape["dcn"],
         mesh.shape["ici"],
     )
+    pack = pack_enabled()
     shard = n_padded // n_dev
     tiles_per_shard = shard // block
 
     def per_device(t):
-        pre = _precompute(t)
+        pre = _precompute(t, pack)
         dev = jax.lax.axis_index("dcn") * n_ici + jax.lax.axis_index("ici")
         row0 = dev * shard
         valid_local = (jnp.arange(shard) + row0) < n_pods
@@ -1080,11 +1226,12 @@ def evaluate_grid_counts_sharded(
     mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
         tensors, n_pods, block, mesh
     )
+    pack = pack_enabled()
     tiles_per_dev = n_padded // (n_dev * block)
     shard = n_padded // n_dev
 
     def per_device(t):
-        pre = _precompute(t)
+        pre = _precompute(t, pack)
         # this device's source-row range
         dev = jax.lax.axis_index("x")
         row0 = dev * tiles_per_dev * block
@@ -1093,22 +1240,39 @@ def evaluate_grid_counts_sharded(
         if kernel == "pallas":
             from .pallas_kernel import (
                 _should_interpret,
+                verdict_counts_pallas_packed,
                 verdict_counts_pallas_rect,
             )
 
             e, ig = pre["egress"], pre["ingress"]
             sl = partial(jax.lax.dynamic_slice_in_dim, start_index=row0)
-            partials = verdict_counts_pallas_rect(
-                sl(e["tmatch"], slice_size=shard, axis=1),
-                sl(e["has_target"], slice_size=shard, axis=0),
-                e["tallow_bf"],
-                ig["tmatch"],
-                ig["has_target"],
-                sl(ig["tallow_bf"], slice_size=shard, axis=1),
-                valid_src=sl(valid, slice_size=shard, axis=0),
-                valid_dst=valid,
-                interpret=_should_interpret(),
-            )  # [Q, n_src_tiles_local, 3]
+            if pack:
+                # packed rect form: src = this device's row shard, dst =
+                # the full axis; the packed words slice on the pod axis
+                # exactly like the dense operands
+                partials = verdict_counts_pallas_packed(
+                    sl(e["tmatch_pk"], slice_size=shard, axis=1),
+                    sl(e["has_target"], slice_size=shard, axis=0),
+                    e["tallow_pk"],
+                    ig["tmatch_pk"],
+                    ig["has_target"],
+                    sl(ig["tallow_pk"], slice_size=shard, axis=1),
+                    valid_src=sl(valid, slice_size=shard, axis=0),
+                    valid_dst=valid,
+                    interpret=_should_interpret(),
+                )
+            else:
+                partials = verdict_counts_pallas_rect(
+                    sl(e["tmatch"], slice_size=shard, axis=1),
+                    sl(e["has_target"], slice_size=shard, axis=0),
+                    e["tallow_bf"],
+                    ig["tmatch"],
+                    ig["has_target"],
+                    sl(ig["tallow_bf"], slice_size=shard, axis=1),
+                    valid_src=sl(valid, slice_size=shard, axis=0),
+                    valid_dst=valid,
+                    interpret=_should_interpret(),
+                )  # [Q, n_src_tiles_local, 3]
             return jax.lax.all_gather(
                 partials.reshape(-1, 3), "x", axis=0, tiled=True
             )

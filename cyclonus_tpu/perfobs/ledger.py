@@ -176,6 +176,32 @@ def _bench_run_from_parsed(
             run.tiers_anp_count = int(tiers["anp_count"])
         if isinstance(tiers.get("resolve_s"), (int, float)):
             run.tiers_resolve_s = float(tiers["resolve_s"])
+    roofline = detail.get("roofline")
+    if isinstance(roofline, dict) and isinstance(
+        roofline.get("efficiency_vs_roofline"), (int, float)
+    ):
+        run.roofline_efficiency = float(roofline["efficiency_vs_roofline"])
+    # detail.pack — the bit-packed dtype plan block: its PRESENCE (not
+    # its truth) marks a new-format run, which is what arms the
+    # sentinel's efficiency gate and hard rate floor; the committed
+    # BENCH_r0* fixtures predate it and keep their legacy gating
+    pack = detail.get("pack")
+    if isinstance(pack, dict) and "active" in pack:
+        run.pack_active = bool(pack.get("active"))
+        if isinstance(pack.get("dtype"), str):
+            run.pack_dtype = pack["dtype"]
+        winner = pack.get("winner")
+        if isinstance(winner, dict) and isinstance(
+            winner.get("bs"), int
+        ) and isinstance(winner.get("bd"), int):
+            run.pack_tile = [winner["bs"], winner["bd"]]
+        tune = pack.get("autotune")
+        if isinstance(tune, dict):
+            if isinstance(tune.get("search_s"), (int, float)):
+                run.pack_search_s = float(tune["search_s"])
+            cands = tune.get("candidates")
+            if isinstance(cands, list):
+                run.pack_candidates = len(cands)
     # detail.mesh (the first-class overlapped-ring leg) and the legacy
     # detail.mesh_scaling block share one row schema and ONE parser —
     # the same _ingest_mesh_row the MULTICHIP dryrun tail goes through

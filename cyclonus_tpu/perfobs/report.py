@@ -51,6 +51,13 @@ PERF_CLASS_RATIO = REGISTRY.gauge(
     "that recorded one.",
     labelnames=("run",),
 )
+PERF_ROOFLINE_EFFICIENCY = REGISTRY.gauge(
+    "cyclonus_tpu_perf_roofline_efficiency",
+    "Ledger: measured eval vs the analytic roofline for its shapes "
+    "(detail.roofline.efficiency_vs_roofline); gated >= 0.7 on "
+    "pack-bearing runs.",
+    labelnames=("run",),
+)
 PERF_RUNS = REGISTRY.gauge(
     "cyclonus_tpu_perf_runs",
     "Ledger: ingested runs by failure class.",
@@ -80,6 +87,10 @@ def publish(ledger: Ledger, result: Optional[GateResult] = None) -> None:
         if run.class_compression_ratio is not None:
             PERF_CLASS_RATIO.set(
                 run.class_compression_ratio, run=run.run_id
+            )
+        if run.roofline_efficiency is not None:
+            PERF_ROOFLINE_EFFICIENCY.set(
+                run.roofline_efficiency, run=run.run_id
             )
         if run.failure_class == "ok":
             best = max(best, run.cells_per_sec)
@@ -119,6 +130,16 @@ def trend(ledger: Ledger, result: Optional[GateResult] = None) -> Dict[str, Any]
             for r in ledger.bench_runs()
             if r.class_compression_ratio is not None
         ],
+        "roofline_efficiency": [
+            {
+                "run": r.run_id,
+                "efficiency": r.roofline_efficiency,
+                "pack": r.pack_active,
+                "tile": r.pack_tile,
+            }
+            for r in ledger.bench_runs()
+            if r.roofline_efficiency is not None
+        ],
     }
     if result is not None:
         doc["gate"] = result.to_dict()
@@ -140,8 +161,8 @@ def render_markdown(
     lines = [
         "# Perf observatory",
         "",
-        "| run | kind | class | cells/s | warmup_s | per-chip | cls-ratio | note |",
-        "|---|---|---|---|---|---|---|---|",
+        "| run | kind | class | cells/s | warmup_s | per-chip | cls-ratio | roofline | note |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in ledger.runs:
         per_chip = (
@@ -155,6 +176,12 @@ def render_markdown(
             if r.class_compression_ratio is not None
             else "-"
         )
+        eff = (
+            f"{r.roofline_efficiency:g}"
+            + (" (packed)" if r.pack_active else "")
+            if r.roofline_efficiency is not None
+            else "-"
+        )
         note = ""
         if r.failure_class != "ok":
             note = (r.error or "")[:80]
@@ -162,7 +189,7 @@ def render_markdown(
             f"| {r.run_id} | {r.kind} | {r.failure_class} "
             f"| {_human_rate(r.cells_per_sec) if r.cells_per_sec else '-'} "
             f"| {r.warmup_s if r.warmup_s is not None else '-'} "
-            f"| {per_chip} | {ratio} | {note} |"
+            f"| {per_chip} | {ratio} | {eff} | {note} |"
         )
     by_class = ledger.counts_by_class()
     infra = sum(by_class[c] for c in INFRA_CLASSES)

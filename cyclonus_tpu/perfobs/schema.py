@@ -98,6 +98,20 @@ class PerfRun:
     tiers_active: bool = False
     tiers_anp_count: Optional[int] = None
     tiers_resolve_s: Optional[float] = None
+    # detail.roofline.efficiency_vs_roofline — measured eval vs the
+    # analytic limit for the shapes it ran (None: older artifact or
+    # roofline skipped).  Gated >= min_roofline_efficiency on NEW runs
+    # only (pack_active not None marks them); the committed BENCH_r0*
+    # fixtures predate detail.pack and keep ingesting/gating unchanged.
+    roofline_efficiency: Optional[float] = None
+    # detail.pack — the bit-packed dtype plan (None everywhere: older
+    # artifact).  pack_active is the new-run marker the sentinel keys
+    # its efficiency gate and hard rate floor on.
+    pack_active: Optional[bool] = None
+    pack_dtype: Optional[str] = None
+    pack_tile: Optional[List[int]] = None  # tuned [bs, bd] winner
+    pack_search_s: Optional[float] = None
+    pack_candidates: Optional[int] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -129,6 +143,12 @@ class PerfRun:
             "tiers_active": self.tiers_active,
             "tiers_anp_count": self.tiers_anp_count,
             "tiers_resolve_s": self.tiers_resolve_s,
+            "roofline_efficiency": self.roofline_efficiency,
+            "pack_active": self.pack_active,
+            "pack_dtype": self.pack_dtype,
+            "pack_tile": self.pack_tile,
+            "pack_search_s": self.pack_search_s,
+            "pack_candidates": self.pack_candidates,
             "error": self.error,
             "metric": self.metric,
         }

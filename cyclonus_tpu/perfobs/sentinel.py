@@ -145,6 +145,7 @@ def gate(
     phase_tol: float = 0.50,
     phase_slack_s: float = 2.0,
     min_scaling_efficiency: float = 0.5,
+    min_roofline_efficiency: float = 0.7,
     candidate: Optional[PerfRun] = None,
 ) -> GateResult:
     """Gate the candidate (default: latest bench run) against the
@@ -208,13 +209,21 @@ def gate(
         )
 
     # --- throughput: higher is better, best-of-N baseline ---------------
+    # NEW-FORMAT runs (detail.pack present -> pack_active not None) gate
+    # against the min-of-N best as a HARD FLOOR: the bit-packed kernel's
+    # acceptance is "at least the old rate", so the 30% noise tolerance
+    # that protects legacy trend gating would hide exactly the
+    # regression the floor exists to catch.  Legacy artifacts (the
+    # committed BENCH_r0* fixtures) keep the tolerant bound unchanged.
     rates = [r.cells_per_sec for r in baselines if r.cells_per_sec > 0]
     if rates and candidate.cells_per_sec > 0:
         best = max(rates)
-        bound = best * (1.0 - rate_tol)
+        hard_floor = candidate.pack_active is not None
+        bound = best if hard_floor else best * (1.0 - rate_tol)
         deltas.append(
             Delta(
-                metric="cells_per_sec",
+                metric="cells_per_sec"
+                + ("[hard-floor]" if hard_floor else ""),
                 candidate=candidate.cells_per_sec,
                 baseline=best,
                 bound=bound,
@@ -222,6 +231,33 @@ def gate(
                 direction="min",
                 baseline_runs=base_ids,
             )
+        )
+
+    # --- roofline efficiency: the bit-packed kernel's headline gate -----
+    # measured eval vs the analytic limit for its own shapes (bench
+    # detail.roofline).  Gated on new-format runs only: legacy fixtures
+    # carry the field (r05: 0.433) but predate the packed kernel, and
+    # retroactively failing them would poison the whole trajectory.
+    if candidate.pack_active is not None and isinstance(
+        candidate.roofline_efficiency, (int, float)
+    ):
+        deltas.append(
+            Delta(
+                metric="roofline_efficiency",
+                candidate=candidate.roofline_efficiency,
+                baseline=1.0,
+                bound=min_roofline_efficiency,
+                regressed=candidate.roofline_efficiency
+                < min_roofline_efficiency,
+                direction="min",
+                baseline_runs=[candidate.run_id],
+            )
+        )
+    elif candidate.pack_active is not None:
+        notes.append(
+            "roofline: new-format run without an efficiency figure — "
+            "the >=%g gate was skipped (roofline leg missing?)"
+            % min_roofline_efficiency
         )
 
     # --- warmup: lower is better, min-of-N baseline ---------------------

@@ -170,6 +170,19 @@ AUTOTUNE_OUTCOMES = REGISTRY.counter(
     "candidate containment (error/timeout).",
     labelnames=("outcome",),
 )
+AUTOTUNE_SEARCHES = REGISTRY.counter(
+    "cyclonus_tpu_autotune_searches_total",
+    "Full candidate searches actually TIMED (compile + min-of-N "
+    "rounds).  A process that adopts a persisted winner never "
+    "increments this — the restart-adoption gate asserts exactly that.",
+)
+AUTOTUNE_CACHE = REGISTRY.counter(
+    "cyclonus_tpu_autotune_cache_total",
+    "Persisted autotune-cache lookups by outcome: hit (winner "
+    "adopted), miss (no/invalid entry -> fresh search), store "
+    "(winner persisted), disabled.",
+    labelnames=("outcome",),
+)
 
 # --- cold-start forensics ------------------------------------------------
 # Rounds 3-4 lost their scoreboard to backend/tunnel init; these count

@@ -18,6 +18,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # default before falling back to the host engine.  Verdicts are engine-
 # independent, so keep the suite fast either way.
 os.environ.setdefault("CYCLONUS_BACKEND_TIMEOUT_S", "15")
+# the persisted autotune cache (engine/autotune.py) defaults to a
+# per-user file under ~/.cache; the suite must never share tuned
+# winners across tests or with the developer's real cache — tests that
+# exercise persistence point this at a tmp_path explicitly
+os.environ.setdefault("CYCLONUS_AUTOTUNE_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

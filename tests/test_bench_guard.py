@@ -58,6 +58,12 @@ class TestBenchGuards:
         assert out["failure_class"] == "watchdog_stall"
         phases = [p[0] for p in out["detail"]["phase_history_s"]]
         assert "startup" in phases  # history present and labeled
+        # detail.pack rides FAILURE lines too (env-resolved plan; no
+        # engine means no winner/autotune forensics yet)
+        pack = out["detail"]["pack"]
+        assert pack["active"] is True  # CYCLONUS_PACK default
+        assert pack["dtype"] == "packed32"
+        assert pack["winner"] is None
 
     def test_stall_bound_fires_inside_one_phase(self):
         """The per-phase stall trigger: total deadline generous, but a
@@ -126,6 +132,9 @@ class TestBenchGuards:
         cold = out["detail"]["cold_start"]
         assert cold["outcome"] == "tunnel"
         assert cold["attempts"] >= 1
+        # detail.pack present on the init-failure line (shape only)
+        assert "pack" in out["detail"]
+        assert "active" in out["detail"]["pack"]
         leg = out["detail"]["cpu_fallback"]
         assert leg["backend"] == "cpu"
         assert leg["value"] > 0
@@ -219,6 +228,15 @@ class TestBenchGuards:
         assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
         # roofline only reports for the pallas backend
         assert detail["roofline"] is None
+        # detail.pack rides EVERY success line: the dtype plan, the
+        # packed word depths, and the autotune forensics slot (None on
+        # CPU, where the auto search never engages)
+        pack = detail["pack"]
+        assert pack["active"] is True
+        assert pack["dtype"] == "packed32"
+        assert isinstance(pack["words"], list) and len(pack["words"]) == 2
+        assert all(w >= 1 for w in pack["words"])
+        assert "winner" in pack and "autotune" in pack
         # class compression rides EVERY line (perfobs reads its ratio);
         # at 256 pods the auto mode stays on the legacy paths
         cc = detail["class_compression"]

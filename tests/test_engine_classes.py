@@ -504,6 +504,9 @@ class TestBudgetAccounting:
         exactly that amount."""
         from cyclonus_tpu.engine.pallas_kernel import SLAB_BD, SLAB_BS, slab_w_aug
 
+        # the slab plan is a legacy-dtype-plan feature: the packed plan
+        # (CYCLONUS_PACK default) retires it, so pin the kill switch
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         monkeypatch.setenv("CYCLONUS_PALLAS_DTYPE", "int8")
         n = 4 * SLAB_BS

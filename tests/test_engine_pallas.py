@@ -79,7 +79,8 @@ class TestPallasCounts:
         # (alternating sets each get cached when re-seen, never thrash)
         assert engine.evaluate_grid_counts(B, backend="pallas") == want_b
         assert engine._pre_cache is not None
-        assert engine._pre_cache[1]["egress"]["tallow_bf"].shape[-1] == len(B)
+        tallow_key = "tallow_pk" if engine._pack else "tallow_bf"
+        assert engine._pre_cache[1]["egress"][tallow_key].shape[-1] == len(B)
         # two consecutive distinct foreign sets evict outright
         want_c = engine.evaluate_grid_counts(C, backend="xla")
         assert engine.evaluate_grid_counts(A, backend="pallas") == want_a
@@ -446,6 +447,7 @@ class TestPallasCounts:
         chunked kernels with counts unchanged."""
         import cyclonus_tpu.engine.pallas_kernel as pk
 
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         monkeypatch.setattr(pk, "SLAB_BS", 8)
         monkeypatch.setattr(pk, "SLAB_BD", 8)
@@ -495,6 +497,7 @@ class TestPallasCounts:
         import cyclonus_tpu.engine.pallas_kernel as pk
         from cyclonus_tpu.engine.pallas_kernel import sum_partials
 
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         monkeypatch.setattr(pk, "SLAB_BS", 8)
         monkeypatch.setattr(pk, "SLAB_BD", 8)
@@ -528,6 +531,7 @@ class TestPallasCounts:
         import cyclonus_tpu.engine.pallas_kernel as pk
         from cyclonus_tpu.engine.pallas_kernel import sum_partials
 
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         monkeypatch.setattr(pk, "SLAB_BS", 8)
         monkeypatch.setattr(pk, "SLAB_BD", 8)
@@ -590,6 +594,7 @@ class TestPallasCounts:
 
         import cyclonus_tpu.engine.pallas_kernel as pk
 
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         monkeypatch.setattr(pk, "SLAB_BS", 8)
         monkeypatch.setattr(pk, "SLAB_BD", 8)
@@ -657,6 +662,7 @@ class TestPallasCounts:
         repeat dispatches, and evicted WITH the pre-cache."""
         import cyclonus_tpu.engine.pallas_kernel as pk
 
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         monkeypatch.setattr(pk, "SLAB_BS", 8)
         monkeypatch.setattr(pk, "SLAB_BD", 8)
@@ -873,6 +879,7 @@ class TestSlabLayout:
             [mkpol("allow", "x", LabelSelector.make(), ["Ingress"],
                    ingress=[NetworkPolicyIngressRule()])],
         )
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         monkeypatch.setenv("CYCLONUS_PALLAS_SLAB", "1")
         # this test pins the slab BYTE accounting with an exact budget;
         # class compression would add its aux/index bytes to the same

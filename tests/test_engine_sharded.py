@@ -301,7 +301,11 @@ class TestPeerBufferWatermark:
         # the host-side estimator agrees with what the gauges recorded
         t = engine._tensors_with_cases(CASES)
         t, _ = sharded_mod._pad_pod_arrays(t, len(pods), 8)
-        assert ring_bytes == sharded_mod.peer_buffer_bytes(t, 8, "ring")
+        from cyclonus_tpu.engine.encoding import pack_enabled
+
+        assert ring_bytes == sharded_mod.peer_buffer_bytes(
+            t, 8, "ring", pack=pack_enabled()
+        )
         assert ag_bytes == sharded_mod.peer_buffer_bytes(t, 8, "allgather")
 
 

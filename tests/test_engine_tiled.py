@@ -133,9 +133,9 @@ class TestTiledCounts:
         seen = []
         real_precompute = tiled._precompute
 
-        def recording_precompute(tensors):
+        def recording_precompute(tensors, *args, **kwargs):
             seen.append(int(tensors["pod_kv"].shape[0]))
-            return real_precompute(tensors)
+            return real_precompute(tensors, *args, **kwargs)
 
         monkeypatch.setattr(tiled, "_precompute", recording_precompute)
         block = 4

@@ -35,7 +35,7 @@ from cyclonus_tpu.perfobs import report as perf_report  # noqa: E402
 
 def healthy_line(
     value=100e9, warmup=5.0, encode=1.0, mesh_rows=None, virtual=True,
-    serve=None, tiers=None,
+    serve=None, tiers=None, pack=None, roofline=None,
 ):
     detail = {
         "build_s": 0.5,
@@ -79,6 +79,10 @@ def healthy_line(
         detail["serve"] = serve
     if tiers is not None:
         detail["tiers"] = tiers
+    if pack is not None:
+        detail["pack"] = pack
+    if roofline is not None:
+        detail["roofline"] = roofline
     return {
         "metric": "simulated connectivity cells/sec (bench)",
         "value": value,
@@ -718,6 +722,166 @@ class TestTiersFields:
         )
         result = gate(led)
         assert result.status == "pass", result.report()
+
+
+class TestPackAndRooflineFields:
+    """detail.pack / detail.roofline: new-format runs gate roofline
+    efficiency >= 0.7 and their cells/s against the min-of-N best as a
+    HARD floor; old artifacts (no detail.pack) keep the legacy bounds —
+    the committed BENCH_r0* fixtures must keep ingesting and the real
+    trajectory must keep passing (TestRealArtifacts pins that)."""
+
+    def _ledger(self, *docs, tmp_path):
+        return load_ledger(write_rounds(tmp_path, list(docs)))
+
+    PACK = {
+        "active": True,
+        "dtype": "packed32",
+        "words": [2, 1],
+        "winner": {"kernel": "packed", "bs": 1024, "bd": 512},
+        "autotune": {
+            "source": "search",
+            "search_s": 3.2,
+            "candidates": [{"kernel": "packed", "bs": 512, "bd": 512},
+                           {"kernel": "packed", "bs": 1024, "bd": 512}],
+        },
+        "cache_path": "/tmp/autotune.json",
+    }
+
+    def test_ledger_parses_pack_and_roofline(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(
+                pack=self.PACK,
+                roofline={"efficiency_vs_roofline": 0.81, "bound": "vpu_s"},
+            )),
+            tmp_path=tmp_path,
+        )
+        (run,) = led.bench_runs()
+        assert run.pack_active is True
+        assert run.pack_dtype == "packed32"
+        assert run.pack_tile == [1024, 512]
+        assert run.pack_search_s == 3.2
+        assert run.pack_candidates == 2
+        assert run.roofline_efficiency == 0.81
+
+    def test_old_artifacts_parse_with_pack_none(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line()), tmp_path=tmp_path
+        )
+        (run,) = led.bench_runs()
+        assert run.pack_active is None
+        assert run.pack_dtype is None
+        # legacy runs carry roofline_efficiency when the block exists
+        # but are NEVER efficiency-gated (pack_active is the marker)
+        assert run.roofline_efficiency is None
+
+    def test_efficiency_gate_fails_below_bound(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(
+                value=120e9,
+                pack=self.PACK,
+                roofline={"efficiency_vs_roofline": 0.43},
+            )),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        bad = {d.metric for d in result.regressions}
+        assert "roofline_efficiency" in bad
+
+    def test_efficiency_gate_passes_at_bound(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(
+                value=120e9,
+                pack=self.PACK,
+                roofline={"efficiency_vs_roofline": 0.74},
+            )),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+    def test_legacy_low_efficiency_not_gated(self, tmp_path):
+        # an r05-style artifact: roofline present (0.433) but NO pack
+        # block — must keep passing (retro-gating would poison history)
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(
+                value=110e9,
+                roofline={"efficiency_vs_roofline": 0.433},
+            )),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert not any(
+            d.metric == "roofline_efficiency" for d in result.deltas
+        )
+
+    def test_pack_run_without_roofline_notes_skip(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=120e9, pack=self.PACK)),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert any("roofline" in n for n in result.notes)
+
+    def test_hard_rate_floor_on_pack_runs(self, tmp_path):
+        # a pack-bearing run 10% below the best baseline: inside the
+        # legacy 30% tolerance, but the hard floor fails it
+        led = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=132.7e9)),
+            wrap(3, healthy_line(
+                value=120e9,
+                pack=self.PACK,
+                roofline={"efficiency_vs_roofline": 0.8},
+            )),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression"
+        bad = {d.metric for d in result.regressions}
+        assert "cells_per_sec[hard-floor]" in bad
+        # the same drop WITHOUT a pack block stays inside tolerance
+        led2 = self._ledger(
+            wrap(1, healthy_line(value=100e9)),
+            wrap(2, healthy_line(value=132.7e9)),
+            wrap(3, healthy_line(value=120e9)),
+            tmp_path=tmp_path,
+        )
+        assert gate(led2).status == "pass"
+
+    def test_pack_run_at_or_above_best_passes_floor(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(value=132.7e9)),
+            wrap(2, healthy_line(
+                value=140e9,
+                pack=self.PACK,
+                roofline={"efficiency_vs_roofline": 0.75},
+            )),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+    def test_round_trip_preserves_pack_fields(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(
+                pack=self.PACK,
+                roofline={"efficiency_vs_roofline": 0.9},
+            )),
+            tmp_path=tmp_path,
+        )
+        (run,) = led.bench_runs()
+        back = PerfRun.from_dict(json.loads(json.dumps(run.to_dict())))
+        assert back.pack_tile == run.pack_tile
+        assert back.roofline_efficiency == run.roofline_efficiency
+        assert back.pack_active == run.pack_active
 
 
 class TestReport:

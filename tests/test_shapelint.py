@@ -372,6 +372,119 @@ class TestSC004TileAlignment:
         assert findings == []
 
 
+class TestSC004PackedLaneArithmetic:
+    """The packed-word (32-per-word) round-ups of the bit-packed kernel
+    must be prover-discharged like the 128-lane lane_round_up — both
+    locally and THROUGH IMPORTS (the cross-file registry resolution)."""
+
+    def _lint_two(self, tmp_path, a_src, b_src):
+        import textwrap
+
+        (tmp_path / "enc.py").write_text(textwrap.dedent(a_src))
+        (tmp_path / "use.py").write_text(textwrap.dedent(b_src))
+        findings, _stats = shapelint.lint_paths(
+            [str(tmp_path / "enc.py"), str(tmp_path / "use.py")]
+        )
+        return findings
+
+    def test_packed_round_up_discharges_locally(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            PACK_BITS = 32
+
+            def packed_words(n):
+                return -(-max(int(n), 1) // PACK_BITS)
+
+            def f(t):
+                total = packed_words(t) * PACK_BITS  # tile: 32
+                return total
+            """,
+            prelude="",
+        )
+        assert findings == []
+
+    def test_packed_round_up_discharges_through_import(self, tmp_path):
+        findings = self._lint_two(
+            tmp_path,
+            """
+            PACK_BITS = 32
+
+            def packed_words(n):
+                return -(-max(int(n), 1) // PACK_BITS)
+            """,
+            """
+            from enc import PACK_BITS, packed_words
+
+            def f(t):
+                total = packed_words(t) * PACK_BITS  # tile: 32
+                return total
+            """,
+        )
+        assert findings == []
+
+    def test_imported_helper_proves_lane_dim(self, tmp_path):
+        # a BlockSpec lane dim built from an IMPORTED round-up helper
+        # (the pallas_kernel.lane_round_up pattern used cross-module)
+        findings = self._lint_two(
+            tmp_path,
+            """
+            def lane_round_up(n):
+                return -(-max(int(n), 1) // 128) * 128
+            """,
+            """
+            from enc import lane_round_up
+
+            def make(pl, w):
+                lanes = lane_round_up(w + 1)  # tile: 128
+                return pl.BlockSpec((8, lanes), lambda i: (i, 0))
+            """,
+        )
+        assert findings == []
+
+    def test_hand_rolled_packed_round_up_flags(self, tmp_path):
+        findings = _lint_source(
+            tmp_path,
+            """
+            def f(t):
+                total = t + 32 - t % 32  # tile: 32
+                return total
+            """,
+            prelude="",
+        )
+        assert _codes(findings) == ["SC004"]
+        assert "tile: 32" in findings[0].message
+
+    def test_imported_const_wrong_multiple_flags(self, tmp_path):
+        # cross-file constants must prove the RIGHT divisibility, not
+        # rubber-stamp: words * 32 is not a multiple of 128
+        findings = self._lint_two(
+            tmp_path,
+            """
+            PACK_BITS = 32
+            """,
+            """
+            from enc import PACK_BITS
+
+            def f(w):
+                bits = w * PACK_BITS  # tile: 128
+                return bits
+            """,
+        )
+        assert _codes(findings) == ["SC004"]
+
+    def test_live_packed_annotations_discharge(self):
+        # the real engine modules: the packed helpers' own `# tile: 32`
+        # assertions must hold with zero SC004 findings
+        findings, stats = shapelint.lint_paths(
+            [
+                os.path.join(REPO, "cyclonus_tpu", "engine", f)
+                for f in ("encoding.py", "kernel.py", "pallas_kernel.py")
+            ]
+        )
+        assert [f for f in findings if f.code == "SC004"] == []
+
+
 class TestWireDrift:
     WIRE_PRELUDE = """
         from typing import ClassVar, Dict

@@ -1014,14 +1014,19 @@ class TestMeshTieredCounts:
         )
         assert np.array_equal(got, want)
 
-    def test_explicit_pallas_counts_request_fails_loudly(self):
-        """The auto default routes tiered counts to the XLA tile body;
-        an EXPLICIT pallas request must raise, not silently publish the
-        XLA rate under the pallas label."""
+    def test_explicit_pallas_counts_request_fails_loudly(self, monkeypatch):
+        """Under the legacy (CYCLONUS_PACK=0) dtype plan the dense
+        pallas kernel cannot express the lattice: the auto default
+        routes tiered counts to the XLA tile body and an EXPLICIT
+        pallas request must raise, not silently publish the XLA rate
+        under the pallas label.  Under the PACKED plan the fused tier
+        epilogue serves pallas counts directly — and stays bit-identical
+        to the oracle."""
         pods, namespaces = mk_cluster()
         ts = TierSet(
             anps=[anp("d", 1, TierScope(), ingress=[rule("Deny")])]
         )
+        monkeypatch.setenv("CYCLONUS_PACK", "0")
         engine = TpuPolicyEngine(
             build_network_policies(True, []), pods, namespaces, tiers=ts
         )
@@ -1035,6 +1040,18 @@ class TestMeshTieredCounts:
         )
         counts = engine.evaluate_grid_counts(CASES, block=8)
         assert counts["combined"] == int(want[..., 2].sum())
+        # packed plan: the fused tier epilogue serves an explicit
+        # pallas request, counts pinned to the oracle; the sharded
+        # per-device kernel keeps the loud failure (no fused tier there)
+        monkeypatch.setenv("CYCLONUS_PACK", "1")
+        packed = TpuPolicyEngine(
+            build_network_policies(True, []), pods, namespaces, tiers=ts
+        )
+        pcounts = packed.evaluate_grid_counts(CASES, backend="pallas")
+        assert pcounts["combined"] == int(want[..., 2].sum())
+        assert pcounts == counts
+        with pytest.raises(ValueError, match="precedence-tier"):
+            packed.evaluate_grid_counts_sharded(CASES, kernel="pallas")
 
 
 # --- audit layer -----------------------------------------------------------

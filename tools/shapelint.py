@@ -268,6 +268,14 @@ class ModuleScan:
     wire_contracts: Dict[str, Dict[str, bool]] = field(default_factory=dict)
     functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
     classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    # `from X import Y [as Z]` aliases (alias -> original name): the
+    # SC004 prover resolves these through the cross-file registry, so a
+    # round-up helper or a packing constant (encoding.PACK_BITS,
+    # pallas_kernel.lane_round_up) proves in every module that imports
+    # it, not just where it is defined
+    imports: Dict[str, str] = field(default_factory=dict)
+    # module-level integer-literal constants (PACK_BITS = 32, BS = 512)
+    int_consts: Dict[str, int] = field(default_factory=dict)
     n_annotations: int = 0
 
 
@@ -303,6 +311,16 @@ def scan_module(path: str, source: str) -> Optional[ModuleScan]:
     except SyntaxError:
         return None
     scan = ModuleScan(path, tree, source.splitlines())
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ImportFrom):
+            for alias in stmt.names:
+                scan.imports[alias.asname or alias.name] = alias.name
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+            isinstance(stmt.targets[0], ast.Name)
+        ):
+            c = _const_int(stmt.value)
+            if c is not None:
+                scan.int_consts[stmt.targets[0].id] = c
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef):
             scan.functions.setdefault(node.name, node)
@@ -372,6 +390,14 @@ class Registry:
     func_contracts: Dict[str, Dict[str, Spec]] = field(default_factory=dict)
     field_specs: Dict[str, Spec] = field(default_factory=dict)
     masked: Dict[str, str] = field(default_factory=dict)
+    # cross-file prover facts (first definition wins — best-effort like
+    # every prover rule; a wrong merge can only HIDE a finding, never
+    # invent one): module-level int constants and function ASTs, so
+    # imported round-up helpers (lane_round_up, packed_words) and
+    # packing constants (PACK_BITS) discharge SC004 wherever they are
+    # USED, not just where they are defined
+    int_consts: Dict[str, int] = field(default_factory=dict)
+    functions: Dict[str, "ast.FunctionDef"] = field(default_factory=dict)
 
     def absorb(self, scan: ModuleScan) -> None:
         for cls, fields in scan.class_contracts.items():
@@ -385,6 +411,10 @@ class Registry:
             for name, sp in specs.items():
                 if sp.mask:
                     self.masked.setdefault(name, sp.mask)
+        for name, val in scan.int_consts.items():
+            self.int_consts.setdefault(name, val)
+        for name, fn_ast in scan.functions.items():
+            self.functions.setdefault(name, fn_ast)
 
 
 CTOR_FULL = {"full"}
@@ -668,10 +698,14 @@ class Inferencer:
 class Prover:
     """Best-effort 'is this expression a multiple of k' discharge over
     the function's visible assignments plus module constants and one
-    level of same-module call returns."""
+    level of same-module call returns — plus, through the cross-file
+    registry, IMPORTED integer constants and round-up helpers (the
+    packed-lane arithmetic: encoding.PACK_BITS / packed_words and
+    pallas_kernel.lane_round_up prove in every module importing them)."""
 
-    def __init__(self, scan: ModuleScan):
+    def __init__(self, scan: ModuleScan, registry: Optional[Registry] = None):
         self.scan = scan
+        self.registry = registry
         self._defs_cache: Dict[int, Dict[str, List[object]]] = {}
         self._module_defs = self._collect(scan.tree.body)
 
@@ -762,7 +796,11 @@ class Prover:
                 return all(
                     self.prove(a, k, fn, visited, depth + 1) for a in e.args
                 )
-            if fname in self.scan.functions and fname not in visited:
+            if (
+                fname is not None
+                and fname not in visited
+                and self._resolve_fn(fname) is not None
+            ):
                 return self._prove_call(fname, None, k, visited, depth)
             return False
         if isinstance(e, ast.Name):
@@ -776,6 +814,9 @@ class Prover:
             if cand is None and fn is not None:
                 cand = self._module_defs.get(e.id)
             if not cand:
+                c = self._foreign_const(e.id)
+                if c is not None:
+                    return c % k == 0
                 return False
             visited = visited | {key}
             plain_ok = True
@@ -808,10 +849,42 @@ class Prover:
             return saw_plain and plain_ok
         return False
 
+    def _foreign_const(self, name: str) -> Optional[int]:
+        """Integer constant behind a name with no local definition: an
+        explicit `from X import NAME` resolved through the registry, or
+        — for ALL_CAPS names only (constants by convention; anything
+        looser would invite cross-module collisions) — any scanned
+        module's constant.  The latter is what lets a FOREIGN function
+        body (e.g. encoding.packed_words proved from a module that
+        imports it) reference its own module's PACK_BITS."""
+        if self.registry is None:
+            return None
+        orig = self.scan.imports.get(name)
+        if orig is not None:
+            c = self.registry.int_consts.get(orig)
+            if c is not None:
+                return c
+        if name.isupper():
+            return self.registry.int_consts.get(name)
+        return None
+
+    def _resolve_fn(self, fname: str) -> Optional[ast.FunctionDef]:
+        """Same-module function, or an explicitly imported one resolved
+        through the cross-file registry (lane_round_up / packed_words
+        prove where they are used)."""
+        fn = self.scan.functions.get(fname)
+        if fn is not None:
+            return fn
+        if self.registry is not None:
+            orig = self.scan.imports.get(fname)
+            if orig is not None:
+                return self.registry.functions.get(orig)
+        return None
+
     def _prove_call(
         self, fname: str, idx: Optional[int], k: int, visited: Set[str], depth: int
     ) -> bool:
-        fn = self.scan.functions.get(fname)
+        fn = self._resolve_fn(fname)
         if fn is None or fname in visited or depth > 12:
             return False
         visited = visited | {fname}
@@ -850,7 +923,7 @@ class Checker:
         self.scan = scan
         self.registry = registry
         self.inf = Inferencer(scan, registry)
-        self.prover = Prover(scan)
+        self.prover = Prover(scan, registry)
         self.findings: List[Finding] = []
 
     def _add(self, node: ast.AST, code: str, message: str) -> None:
