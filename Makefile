@@ -23,13 +23,14 @@ lint: shapelint
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
-	  cyclonus_tpu/perfobs cyclonus_tpu/serve cyclonus_tpu/tiers
+	  cyclonus_tpu/perfobs cyclonus_tpu/serve cyclonus_tpu/tiers \
+	  cyclonus_tpu/chaos
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
 	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve \
-	  cyclonus_tpu/tiers
+	  cyclonus_tpu/tiers cyclonus_tpu/chaos
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -67,12 +68,22 @@ multichip-smoke:
 	JAX_PLATFORMS=cpu python -c \
 	  "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
 
+# the seeded fault-injection suite (docs/DESIGN.md "Cold start &
+# chaos"): kill/restart serve mid-churn with a bounded time-to-first-
+# verdict, poison/truncate the AOT + autotune caches, flake backend
+# init, kill the worker wire, drop a delta batch mid-apply — every
+# fault must degrade as designed (retry / rollback / fresh compile)
+# with oracle parity preserved.  Bounded and seeded so it rides inside
+# `make check`.
+chaos:
+	JAX_PLATFORMS=cpu python -m cyclonus_tpu chaos --seed 0
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
 # smoke the verdict service and the 8-device overlapped mesh path, run
-# the seeded tier fuzz gate (mesh leg included), then run the suite on
-# a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed serve-smoke multichip-smoke fuzz
+# the seeded tier fuzz gate (mesh leg included), run the chaos suite,
+# then run the suite on a CPU 8-device mesh
+check: vet lint perf-gate parity-compressed serve-smoke multichip-smoke fuzz chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -121,4 +132,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench fmt vet lint shapelint perf-gate parity-compressed serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint shapelint perf-gate parity-compressed serve-smoke multichip-smoke cyclonus docker

@@ -175,8 +175,24 @@ def _trace_detail(trace_dir: str) -> dict:
     return {"dir": trace_dir or None, "written": written}
 
 
+def _aot_snapshot() -> dict:
+    """AOT executable-cache counters, trimmed to the cold_start schema.
+    The SUCCESS path snapshots this at END OF WARMUP, not end of run:
+    the later legs (serve churn, parity, mega) build fresh engines that
+    adopt entries THIS process just stored, and counting those would
+    mark a genuinely cold run cache-bearing — arming the perfobs hard
+    warmup ceiling against a run that legitimately paid its compiles."""
+    from cyclonus_tpu.engine import aot_cache
+
+    return {
+        k: v
+        for k, v in aot_cache.counters().items()
+        if k in ("hits", "misses", "adopted", "stores", "compiles", "dir")
+    }
+
+
 def _cold_start_detail(
-    init_state: dict, backend_init_s, outcome: str
+    init_state: dict, backend_init_s, outcome: str, aot: dict = None
 ) -> dict:
     """The detail.cold_start block: how many attach attempts the
     overlapped init thread made, how long it backed off between them,
@@ -190,6 +206,16 @@ def _cold_start_detail(
         if backend_init_s is not None
         else None,
         "outcome": outcome,
+        # structured last-error (exception class + truncated message):
+        # None on a clean first-attempt attach
+        "last_error": init_state.get("last_error"),
+        # persistent AOT executable-cache forensics: adopted > 0 is the
+        # zero-recompile restart proof, and the perfobs sentinel
+        # hard-gates warmup_s on exactly these cache-bearing runs.
+        # `aot` is the end-of-warmup snapshot on success lines (see
+        # _aot_snapshot); failure paths take the counters as they stand
+        # at death.
+        "aot_cache": aot if aot is not None else _aot_snapshot(),
     }
 
 
@@ -1049,6 +1075,54 @@ def _serve_churn_leg(cases, n_pods: int, n_policies: int):
     }
 
 
+def _chaos_leg():
+    """BENCH chaos leg (detail.chaos): SIGKILL a `cyclonus-tpu serve`
+    replica mid-churn, restart it against the same persistent caches,
+    and HARD-BOUND its time-to-first-verdict (CYCLONUS_CHAOS_TTFV_S —
+    the scenario raises past the bound, and that AssertionError fails
+    the bench), with oracle parity checked on every post-restart
+    verdict (chaos/harness.py scenario_serve_kill_restart).
+
+    BENCH_CHAOS: "auto" (default — run on TPU, where the restart cost
+    is the number that matters; skip on CPU, where `make chaos` covers
+    the same scenario without doubling the CI bench), "1" force,
+    "0" skip.  The block — and its schema — rides EVERY line either
+    way, like detail.mesh."""
+    mode = os.environ.get("BENCH_CHAOS", "auto").lower()
+    skipped = None
+    if mode == "0":
+        skipped = "BENCH_CHAOS=0"
+    elif mode != "1":
+        import jax
+
+        if jax.default_backend() != "tpu":
+            skipped = "auto (non-TPU backend; `make chaos` covers it)"
+    if skipped:
+        return {"skipped": skipped, "ttfv_s": None}
+    from cyclonus_tpu.chaos import harness
+    from cyclonus_tpu.utils.bounded import run_bounded
+
+    n_pods = int(os.environ.get("BENCH_CHAOS_PODS", "128"))
+    steps = int(os.environ.get("BENCH_CHAOS_DELTAS", "6"))
+    _stall_env = float(os.environ.get("BENCH_STALL_S", "300"))
+    _bound = min(420.0, _stall_env * 0.8) if _stall_env > 0 else 600.0
+    status, value = run_bounded(
+        lambda: harness.scenario_serve_kill_restart(
+            seed=20260804, n_pods=n_pods, churn_steps=steps
+        ),
+        _bound,
+    )
+    if status == "ok":
+        return value
+    if status == "error" and isinstance(value, AssertionError):
+        raise value  # the TTFV bound or a parity failure: hard
+    return {
+        "status": status,
+        "error": None if status == "timeout" else repr(value),
+        "ttfv_s": None,
+    }
+
+
 def tiers_case(cases, headline_pods: int, headline_policies: int) -> dict:
     """BENCH tiers leg (detail.tiers): the precedence-tier lattice on a
     BENCH_TIERS_PODS-pod synthetic cluster under a deterministic
@@ -1368,6 +1442,12 @@ def _bench(done):
                         # test hook: backend answers and fails (the
                         # r03 class), exercising the retry/backoff path
                         raise RuntimeError("fake backend init error")
+                    # chaos point `backend_init`: an injected attach
+                    # failure rides the SAME retry/backoff/forensics
+                    # path a real r03-class fault takes
+                    from cyclonus_tpu import chaos
+
+                    chaos.fire("backend_init")
                     import jax
 
                     jax.devices()
@@ -1379,6 +1459,13 @@ def _bench(done):
                 return
             except Exception as e:  # surfaced via the join below
                 init_state["error"] = f"{type(e).__name__}: {e}"
+                # STRUCTURED last-error for the JSON line: perfobs
+                # forensics can split SIGILL-class host faults from
+                # tunnel death without scraping the stderr tail
+                init_state["last_error"] = {
+                    "type": type(e).__name__,
+                    "message": str(e)[:200],
+                }
                 instruments.BACKEND_INIT_ATTEMPTS.inc(outcome="error")
             if attempt <= max(1, init_retries) - 1:
                 pause = full_jitter_pause(
@@ -1528,6 +1615,9 @@ def _bench(done):
             k: round(v["total_s"], 3)
             for k, v in telemetry.SPANS.stats().items()
         }
+        # AOT forensics frozen HERE: later legs adopt this process's own
+        # stores, which must not mark a cold run cache-bearing
+        aot_warmup = _aot_snapshot()
         _enter_phase("eval")
         times = []
         # BENCH_TRACE_DIR / --trace-dir: profile exactly the steady-state
@@ -1744,6 +1834,8 @@ def _bench(done):
         tiers_detail = _tiers_leg(cases, n_pods, n_policies)
         _enter_phase("serve_churn")
         serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
+        _enter_phase("chaos")
+        chaos_detail = _chaos_leg()
         done.set()
         print(
             json.dumps(
@@ -1772,7 +1864,7 @@ def _bench(done):
                         # cold-start forensics: attach attempts +
                         # jittered backoff behind backend_init_s
                         "cold_start": _cold_start_detail(
-                            init_state, t_init, "ok"
+                            init_state, t_init, "ok", aot=aot_warmup
                         ),
                         "warmup_s": round(t_warm, 3),
                         "warmup_phases": warm_phases,
@@ -1837,6 +1929,7 @@ def _bench(done):
                         # differential-parity assertions enforced
                         # (perfobs reads detail.serve on every line)
                         "serve": serve_detail,
+                        "chaos": chaos_detail,
                         # the precedence-tier leg (BENCH_TIERS=0 skips,
                         # still recording {active: False}): ANP/BANP
                         # lattice resolve_s with oracle spot parity
@@ -1889,6 +1982,8 @@ def _bench(done):
     t0 = time.time()
     grid = run()
     t_warm = time.time() - t0
+    # AOT forensics frozen at end of warmup (same rationale as tiled)
+    aot_warmup = _aot_snapshot()
 
     _enter_phase("eval")
     times = []
@@ -1915,6 +2010,8 @@ def _bench(done):
     tiers_detail = _tiers_leg(cases, n_pods, n_policies)
     _enter_phase("serve_churn")
     serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
+    _enter_phase("chaos")
+    chaos_detail = _chaos_leg()
     done.set()
     print(
         json.dumps(
@@ -1932,7 +2029,7 @@ def _bench(done):
                     "backend_init_s": round(t_init, 3),
                     "phase_history_s": _phase_history(),
                     "cold_start": _cold_start_detail(
-                        init_state, t_init, "ok"
+                        init_state, t_init, "ok", aot=aot_warmup
                     ),
                     "warmup_s": round(t_warm, 3),
                     "eval_s": round(t_eval, 4),
@@ -1942,6 +2039,7 @@ def _bench(done):
                     "class_compression": engine.class_compression_stats(),
                     "mesh": mesh_detail,
                     "serve": serve_detail,
+                    "chaos": chaos_detail,
                     "tiers": tiers_detail,
                     "telemetry": tel_snapshot,
                     "trace": _trace_detail(trace_dir),

@@ -1,0 +1,498 @@
+"""The chaos suite: seeded, bounded fault-injection scenarios
+(docs/DESIGN.md "Cold start & chaos"; `make chaos` runs them all,
+`cyclonus-tpu chaos` is the CLI).
+
+Each scenario injects ONE fault class and asserts the designed
+degradation — retry, rollback, fresh compile, bounded restart — plus
+the differential invariant that matters after the fault: verdicts stay
+oracle-exact.  Scenarios are pure functions returning a report dict
+with an "ok" flag; run_all wraps each in the bounded-run discipline so
+a wedged scenario costs its bound, never the suite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from . import ChaosError, disarm, injected, reset
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: default wall-clock bound on a restarted replica's time-to-first-
+#: verdict (CYCLONUS_CHAOS_TTFV_S overrides; generous because a CPU CI
+#: restart pays the full jax import, not just the engine build)
+DEFAULT_TTFV_BOUND_S = 150.0
+
+
+def _ttfv_bound_s() -> float:
+    try:
+        return float(os.environ.get("CYCLONUS_CHAOS_TTFV_S", str(DEFAULT_TTFV_BOUND_S)))
+    except ValueError:
+        return DEFAULT_TTFV_BOUND_S
+
+
+class _Serve:
+    """A real `cyclonus-tpu serve` subprocess on the JSON-lines wire
+    (stderr to a file so a chatty child can never deadlock the pipe)."""
+
+    def __init__(self, n_pods: int, n_ns: int, seed: int, workdir: str,
+                 tag: str, env: Optional[Dict[str, str]] = None):
+        self.stderr_path = os.path.join(workdir, f"serve-{tag}.stderr")
+        # children INHERIT the caller's backend: `make chaos` and the
+        # test suite export JAX_PLATFORMS=cpu themselves, while the
+        # bench's TPU-only chaos leg exists precisely to measure a TPU
+        # replica's restart (a forced-CPU child would record a CPU
+        # ttfv and could not adopt the TPU AOT entries — platform
+        # stamp mismatch)
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        self._stderr = open(self.stderr_path, "w")
+        self.started_at = time.perf_counter()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "cyclonus_tpu", "serve",
+             "--synthetic-pods", str(n_pods),
+             "--synthetic-namespaces", str(n_ns),
+             "--seed", str(seed)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._stderr, text=True, bufsize=1,
+            env=full_env, cwd=REPO,
+        )
+
+    def round_trip(self, line: str) -> dict:
+        self.proc.stdin.write(line + "\n")
+        self.proc.stdin.flush()
+        reply = self.proc.stdout.readline()
+        if not reply:
+            raise RuntimeError(
+                f"serve died mid-reply (rc={self.proc.poll()}); stderr "
+                f"tail: {open(self.stderr_path).read()[-500:]}"
+            )
+        return json.loads(reply)
+
+    def kill(self) -> None:
+        self.proc.kill()
+        self.proc.wait(timeout=30)
+        self._stderr.close()
+
+    def close(self) -> int:
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+        rc = self.proc.wait(timeout=60)
+        self._stderr.close()
+        return rc
+
+
+def _oracle_check(pods_state, namespaces, netpols, queries, verdicts) -> int:
+    """Every wire verdict must equal the scalar oracle over the SAME
+    post-delta state the harness mirrored — the restarted replica is a
+    rebuild, so this IS the incremental==rebuild==oracle parity leg."""
+    from ..analysis.oracle import oracle_verdicts, traffic_for_cell
+    from ..engine.api import PortCase
+    from ..matcher.builder import build_network_policies
+
+    policy = build_network_policies(True, list(netpols))
+    plist = list(pods_state.values())
+    idx = {f"{p[0]}/{p[1]}": i for i, p in enumerate(plist)}
+    checked = 0
+    for q, v in zip(queries, verdicts):
+        if v.get("Error"):
+            raise AssertionError(f"query errored after fault: {v}")
+        case = PortCase(q.port, q.port_name, q.protocol)
+        want = oracle_verdicts(
+            policy,
+            traffic_for_cell(plist, namespaces, case, idx[q.src], idx[q.dst]),
+        )
+        got = (v["Ingress"], v["Egress"], v["Combined"])
+        if got != want:
+            raise AssertionError(
+                f"CHAOS PARITY: {q.src}->{q.dst}: service={got} "
+                f"oracle={want}"
+            )
+        checked += 1
+    return checked
+
+
+def scenario_serve_kill_restart(
+    seed: int = 0,
+    workdir: Optional[str] = None,
+    n_pods: int = 24,
+    churn_steps: int = 6,
+    ttfv_bound_s: Optional[float] = None,
+) -> Dict:
+    """SIGKILL a serve replica mid-churn, restart it against the same
+    (persistent) caches, and bound its time-to-first-verdict; verdicts
+    after the restart — including after a fresh delta batch — must be
+    oracle-exact."""
+    import tempfile
+
+    from ..cli.serve_cmd import synthetic_cluster
+    from ..worker.model import Batch, Delta, FlowQuery
+
+    bound = ttfv_bound_s if ttfv_bound_s is not None else _ttfv_bound_s()
+    workdir = workdir or tempfile.mkdtemp(prefix="cyclonus-chaos-")
+    n_ns = 3
+    rng = random.Random(seed)
+    pods, namespaces = synthetic_cluster(n_pods, n_ns, seed)
+    state = {f"{p[0]}/{p[1]}": p for p in pods}
+    keys = list(state)
+
+    def churn_line(step: int) -> tuple:
+        key = keys[rng.randrange(len(keys))]
+        ns, name = key.split("/", 1)
+        labels = {"pod": f"p{step}", "app": f"app{rng.randrange(20)}",
+                  "tier": f"tier{rng.randrange(5)}"}
+        return key, labels, Batch(
+            namespace="", pod="", container="",
+            deltas=[Delta(kind="pod_labels", namespace=ns, name=name,
+                          labels=dict(labels))],
+        ).to_json()
+
+    # phase 1: a replica under churn, killed without warning mid-stream
+    srv = _Serve(n_pods, n_ns, seed, workdir, "victim")
+    applied_before_kill = 0
+    for step in range(churn_steps):
+        _key, _labels, line = churn_line(step)
+        reply = srv.round_trip(line)
+        if reply.get("Error"):
+            raise AssertionError(f"churn delta rejected: {reply}")
+        applied_before_kill += 1
+    srv.kill()  # mid-churn: no shutdown, no flush — the crash case
+
+    # phase 2: the restarted replica rebuilds from its source of truth
+    # (the deltas above died with the victim — by design: authoritative
+    # state is upstream, the replica is a cache of it), adopting the
+    # persistent AOT/autotune caches.  TTFV = process start -> first
+    # verdict reply on the wire, prewarm included.
+    rng2 = random.Random(seed + 1)
+    queries = [
+        FlowQuery(src=rng2.choice(keys), dst=rng2.choice(keys), port=80,
+                  protocol="TCP", port_name="serve-80-tcp")
+        for _ in range(8)
+    ]
+    srv2 = _Serve(n_pods, n_ns, seed, workdir, "restarted")
+    reply = srv2.round_trip(Batch(
+        namespace="", pod="", container="", queries=queries,
+    ).to_json())
+    ttfv_s = time.perf_counter() - srv2.started_at
+    checked = _oracle_check(
+        {f"{p[0]}/{p[1]}": p for p in pods}, namespaces, [],
+        queries, reply.get("Verdicts") or [],
+    )
+    # post-restart churn: the incremental path must survive the fault
+    key, labels, line = churn_line(999)
+    delta_reply = srv2.round_trip(line)
+    if delta_reply.get("Mode") not in ("incremental", "class_rebuild"):
+        raise AssertionError(
+            f"post-restart delta fell off the incremental path: "
+            f"{delta_reply}"
+        )
+    p = state[key]
+    post_state = dict({f"{q[0]}/{q[1]}": q for q in pods})
+    post_state[key] = (p[0], p[1], labels, p[3])
+    reply2 = srv2.round_trip(Batch(
+        namespace="", pod="", container="", queries=queries,
+    ).to_json())
+    checked += _oracle_check(
+        post_state, namespaces, [], queries, reply2.get("Verdicts") or []
+    )
+    rc = srv2.close()
+    if rc != 0:
+        raise AssertionError(f"restarted serve exited rc={rc}")
+    if ttfv_s > bound:
+        raise AssertionError(
+            f"time-to-first-verdict {ttfv_s:.1f}s exceeds the "
+            f"{bound:g}s bound (CYCLONUS_CHAOS_TTFV_S)"
+        )
+    return {
+        "ok": True,
+        "applied_before_kill": applied_before_kill,
+        "ttfv_s": round(ttfv_s, 3),
+        "ttfv_bound_s": bound,
+        "oracle_checked": checked,
+    }
+
+
+def _poison_file(path: str, mode: str) -> None:
+    if mode == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            head = f.read(max(1, size // 2))
+        with open(path, "wb") as f:
+            f.write(head)
+    elif mode == "garbage":
+        with open(path, "wb") as f:
+            f.write(b"\x00not a pickle\xff" * 64)
+    elif mode == "version_skew":
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump({"v": 9999, "key": "?", "payload": b""}, f)
+    else:
+        raise ValueError(mode)
+
+
+def scenario_poisoned_caches(
+    seed: int = 0, workdir: Optional[str] = None, n_pods: int = 24
+) -> Dict:
+    """Poison/truncate/version-skew every persisted cache — AOT
+    executables AND the autotune winners — then build a fresh engine:
+    it must degrade to fresh compiles (never raise) and stay
+    bit-identical to the pre-poison engine."""
+    import tempfile
+
+    import numpy as np
+
+    workdir = workdir or tempfile.mkdtemp(prefix="cyclonus-chaos-")
+    aot_dir = os.path.join(workdir, "aot")
+    tune_path = os.path.join(workdir, "autotune.json")
+    saved = {
+        k: os.environ.get(k)
+        for k in ("CYCLONUS_AOT_CACHE", "CYCLONUS_AUTOTUNE_CACHE")
+    }
+    os.environ["CYCLONUS_AOT_CACHE"] = aot_dir
+    os.environ["CYCLONUS_AUTOTUNE_CACHE"] = tune_path
+    try:
+        from ..cli.serve_cmd import synthetic_cluster
+        from ..engine import PortCase, TpuPolicyEngine
+        from ..engine import aot_cache
+        from ..matcher.builder import build_network_policies
+        from ..telemetry import instruments as ti
+
+        pods, namespaces = synthetic_cluster(n_pods, 3, seed)
+        policy = build_network_policies(True, [])
+        cases = [PortCase(80, "chaos-80-tcp", "TCP")]
+        eng_a = TpuPolicyEngine(policy, pods, namespaces)
+        grid_a = np.asarray(eng_a.evaluate_grid(cases).combined)
+        pairs_a = eng_a.evaluate_pairs(cases, [(0, 1), (1, 0)])
+        entries = sorted(
+            os.path.join(aot_dir, f)
+            for f in os.listdir(aot_dir)
+            if f.endswith(".aotx")
+        )
+        if not entries:
+            raise AssertionError("no AOT entries written to poison")
+        modes = ["truncate", "garbage", "version_skew"]
+        for i, path in enumerate(entries):
+            _poison_file(path, modes[i % len(modes)])
+        with open(tune_path, "w") as f:
+            f.write('{"v": 1, "entries": {truncated')
+        corrupt0 = ti.AOT_CACHE.value(outcome="corrupt") + ti.AOT_CACHE.value(
+            outcome="stale"
+        )
+        eng_b = TpuPolicyEngine(policy, pods, namespaces)
+        grid_b = np.asarray(eng_b.evaluate_grid(cases).combined)
+        pairs_b = eng_b.evaluate_pairs(cases, [(0, 1), (1, 0)])
+        if not np.array_equal(grid_a, grid_b):
+            raise AssertionError("grid diverged after cache poisoning")
+        if not np.array_equal(pairs_a, pairs_b):
+            raise AssertionError("pairs diverged after cache poisoning")
+        rejected = (
+            ti.AOT_CACHE.value(outcome="corrupt")
+            + ti.AOT_CACHE.value(outcome="stale")
+            - corrupt0
+        )
+        if rejected <= 0:
+            raise AssertionError(
+                "poisoned AOT entries were not detected (no corrupt/"
+                "stale outcomes counted)"
+            )
+        return {
+            "ok": True,
+            "entries_poisoned": len(entries),
+            "rejected": int(rejected),
+            "aot": aot_cache.counters(),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def scenario_backend_init_flake(seed: int = 0, failures: int = 2) -> Dict:
+    """Arm the `backend_init` point for N failures and drive the
+    bench-shaped retry envelope (same jittered backoff helper): the
+    attach must recover on attempt N+1 with the structured last-error
+    retained — the exact forensics bench.py ships in
+    detail.cold_start."""
+    from ..utils.retry import full_jitter_pause
+    from . import fire
+
+    tok = reset(f"backend_init:{failures}")
+    try:
+        rng = random.Random(seed)
+        state: Dict = {"attempts": 0, "last_error": None}
+        recovered_at = None
+        for attempt in range(1, failures + 2):
+            state["attempts"] = attempt
+            try:
+                fire("backend_init")
+                recovered_at = attempt
+                break
+            except ChaosError as e:
+                state["last_error"] = {
+                    "type": type(e).__name__,
+                    "message": str(e)[:200],
+                }
+            time.sleep(min(0.05, full_jitter_pause(0.01, attempt, rng)))
+        if recovered_at != failures + 1:
+            raise AssertionError(
+                f"retry loop recovered at attempt {recovered_at}, "
+                f"expected {failures + 1}"
+            )
+        if (state["last_error"] or {}).get("type") != "ChaosError":
+            raise AssertionError(
+                f"structured last_error missing: {state['last_error']}"
+            )
+        return {
+            "ok": True,
+            "attempts": state["attempts"],
+            "last_error": state["last_error"],
+            "injected": injected(),
+        }
+    finally:
+        disarm(tok)
+
+
+class _InProcessKube:
+    """The minimal IKubernetes a worker Client needs: run the in-pod
+    worker in-process (same JSON contract as kubectl exec)."""
+
+    def execute_remote_command(self, namespace, pod, container, command):
+        from ..worker.worker import run_worker
+
+        return run_worker(command[2]), "", None
+
+
+def scenario_worker_wire(seed: int = 0, failures: int = 2) -> Dict:
+    """Kill the worker wire N times mid-batch: the driver-side client
+    must retry with backoff (cyclonus_tpu_worker_retries_total moves)
+    and the batch must complete — a dead worker wedges nothing."""
+    from ..telemetry import instruments as ti
+    from ..worker.client import Client
+    from ..worker.model import Batch
+
+    tok = reset(f"worker_wire:{failures}")
+    saved = {
+        k: os.environ.get(k)
+        for k in ("CYCLONUS_WORKER_BACKOFF_S", "CYCLONUS_WORKER_TIMEOUT_S")
+    }
+    os.environ["CYCLONUS_WORKER_BACKOFF_S"] = "0.01"
+    try:
+        retries0 = ti.WORKER_RETRIES.value()
+        client = Client(_InProcessKube())
+        results = client.batch(
+            Batch(namespace="x", pod="a", container="c", requests=[])
+        )
+        retried = int(ti.WORKER_RETRIES.value() - retries0)
+        if retried != failures:
+            raise AssertionError(
+                f"expected {failures} retries, counted {retried}"
+            )
+        return {
+            "ok": True,
+            "retries": retried,
+            "results": len(results),
+            "injected": injected(),
+        }
+    finally:
+        disarm(tok)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def scenario_delta_drop(seed: int = 0, n_pods: int = 16) -> Dict:
+    """Drop a delta batch mid-apply (after the authoritative dicts
+    mutated): the service must roll the batch back wholesale, stay
+    incremental==rebuild==oracle consistent, and accept the next batch
+    cleanly."""
+    from ..cli.serve_cmd import synthetic_cluster
+    from ..serve import VerdictService
+    from ..worker.model import Delta
+
+    pods, namespaces = synthetic_cluster(n_pods, 2, seed)
+    svc = VerdictService(pods, namespaces, [])
+    epoch0 = svc.epoch
+    key = next(iter(svc.pods))
+    ns, name = key.split("/", 1)
+    delta = Delta(kind="pod_labels", namespace=ns, name=name,
+                  labels={"app": "chaos", "pod": "p0", "tier": "t0"})
+    tok = reset("delta_apply:1")
+    try:
+        raised = False
+        try:
+            svc.apply([delta])
+        except ChaosError:
+            raised = True
+        if not raised:
+            raise AssertionError("injected delta_apply fault did not fire")
+        if svc.epoch != epoch0:
+            raise AssertionError("epoch advanced through a dropped batch")
+        if svc.pods[key][2].get("app") == "chaos":
+            raise AssertionError("rollback left the mutated pod labels")
+        parity1 = svc.verify_parity(oracle_samples=8)
+        report = svc.apply([delta])
+        if report["epoch"] != epoch0 + 1:
+            raise AssertionError(f"post-fault apply failed: {report}")
+        parity2 = svc.verify_parity(oracle_samples=8)
+        return {
+            "ok": True,
+            "rolled_back": True,
+            "parity": [parity1, parity2],
+            "injected": injected(),
+        }
+    finally:
+        disarm(tok)
+
+
+SCENARIOS = {
+    "serve_kill_restart": scenario_serve_kill_restart,
+    "poisoned_caches": scenario_poisoned_caches,
+    "backend_init_flake": scenario_backend_init_flake,
+    "worker_wire": scenario_worker_wire,
+    "delta_drop": scenario_delta_drop,
+}
+
+
+def run_all(
+    seed: int = 0,
+    only: Optional[List[str]] = None,
+    bound_s: float = 420.0,
+) -> Dict:
+    """Run the (selected) scenarios, each bounded; returns the suite
+    report with per-scenario results and the overall ok flag."""
+    from ..utils.bounded import run_bounded
+
+    names = only or list(SCENARIOS)
+    out: Dict = {"seed": seed, "scenarios": {}, "ok": True}
+    for name in names:
+        fn = SCENARIOS[name]
+        t0 = time.perf_counter()
+        status, value = run_bounded(lambda f=fn: f(seed=seed), bound_s)
+        if status == "ok":
+            report = value
+        else:
+            report = {
+                "ok": False,
+                "error": (
+                    f"scenario exceeded the {bound_s:g}s bound"
+                    if status == "timeout"
+                    else f"{type(value).__name__}: {value}"
+                ),
+            }
+        report["seconds"] = round(time.perf_counter() - t0, 3)
+        out["scenarios"][name] = report
+        out["ok"] = out["ok"] and bool(report.get("ok"))
+    return out

@@ -148,7 +148,7 @@ def run_generate(args) -> int:
     if args.resume and not args.journal:
         # validate before any cluster resources get created
         raise SystemExit("--resume requires --journal")
-    from .probe_cmd import _start_metrics, _start_trace
+    from .probe_cmd import _mark_ready, _start_metrics, _start_trace
 
     _start_metrics(args)
     _start_trace(args)
@@ -172,6 +172,7 @@ def run_generate(args) -> int:
     from ._cluster import close_cluster, make_cluster
 
     kubernetes, protocols = make_cluster(args, protocols)
+    _mark_ready(args, "cluster up; generating")
     # pod servers (loopback subprocesses) exist from new_default onward;
     # an exception mid-case must still close the cluster
     try:

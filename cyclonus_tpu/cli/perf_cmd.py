@@ -105,6 +105,16 @@ def setup_perf(sub) -> None:
         "phases from gating on noise)",
     )
     g.add_argument(
+        "--warmup-cached-max",
+        type=float,
+        default=5.0,
+        metavar="S",
+        help="HARD absolute warmup_s ceiling on cache-bearing runs "
+        "(detail.cold_start.aot_cache adopted > 0): a restart that "
+        "adopted its executables has no compile storm left to excuse "
+        "a long warmup",
+    )
+    g.add_argument(
         "--min-scaling-efficiency",
         type=float,
         default=0.5,
@@ -204,6 +214,7 @@ def _run_gate(args) -> int:
         phase_tol=args.phase_tol,
         phase_slack_s=args.phase_slack,
         min_scaling_efficiency=args.min_scaling_efficiency,
+        warmup_cached_max_s=args.warmup_cached_max,
     )
     if args.json:
         print(json.dumps(result.to_dict(), indent=2))

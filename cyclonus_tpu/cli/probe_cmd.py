@@ -32,7 +32,24 @@ def _start_metrics(args) -> None:
         srv = start_metrics_server(args.metrics_port)
     except MetricsPortBusy as e:
         raise SystemExit(f"error: {e}")
+    # honest readiness from the first bind: /healthz says the process
+    # is alive, /readyz says "starting" until the command's own setup
+    # (cluster build, backend probe) completes and _mark_ready flips it
+    from ..telemetry.server import register_readiness
+
+    ready = {"v": False, "detail": "starting: cluster/backend setup in progress"}
+    args._readiness = ready
+    register_readiness(lambda: (ready["v"], ready["detail"]))
     print(f"telemetry: metrics on {srv.url}/metrics (port {srv.port})")
+
+
+def _mark_ready(args, detail: str) -> None:
+    """Flip the /readyz answer registered by _start_metrics (no-op when
+    no metrics server was requested)."""
+    r = getattr(args, "_readiness", None)
+    if r is not None:
+        r["v"] = True
+        r["detail"] = detail
 
 
 def _start_trace(args) -> None:
@@ -182,6 +199,7 @@ def run_probe(args) -> int:
     from ._cluster import close_cluster, make_cluster
 
     kubernetes, protocols = make_cluster(args, protocols)
+    _mark_ready(args, "cluster up; probing")
     # pod servers (loopback subprocesses) exist from new_default onward;
     # an exception anywhere past this point must still close the cluster
     try:

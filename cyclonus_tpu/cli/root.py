@@ -26,6 +26,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     from .analyze import setup_analyze
+    from .chaos_cmd import setup_chaos
     from .fuzz_cmd import setup_fuzz
     from .generate import setup_generate
     from .perf_cmd import setup_perf
@@ -34,6 +35,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .serve_cmd import setup_serve
 
     setup_analyze(sub)
+    setup_chaos(sub)
     setup_fuzz(sub)
     setup_generate(sub)
     setup_perf(sub)

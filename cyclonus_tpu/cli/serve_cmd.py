@@ -86,6 +86,13 @@ def setup_serve(sub) -> None:
         metavar="N",
         help="exit after N input lines (smoke tests)",
     )
+    cmd.add_argument(
+        "--no-prewarm",
+        action="store_true",
+        help="skip the startup prewarm (compile the query-path bucket "
+        "set lazily on first use instead; /readyz reports ready "
+        "immediately).  CYCLONUS_SERVE_PREWARM=0 is the env twin.",
+    )
     cmd.set_defaults(func=run_serve)
 
 
@@ -140,6 +147,12 @@ def run_serve(args) -> int:
         pods, namespaces = synthesize_cluster(compiled)
     for p in policies:
         namespaces.setdefault(p.effective_namespace(), {})
+    import os
+
+    prewarm_on = (
+        not args.no_prewarm
+        and os.environ.get("CYCLONUS_SERVE_PREWARM", "1") != "0"
+    )
     service = VerdictService(
         pods,
         namespaces,
@@ -147,6 +160,7 @@ def run_serve(args) -> int:
         simplify=not args.no_simplify,
         class_compress=args.class_compress or None,
         tiers=tiers,
+        defer_ready=prewarm_on,
     )
     if args.metrics_port is not None:
         try:
@@ -154,10 +168,27 @@ def run_serve(args) -> int:
         except MetricsPortBusy as e:
             raise SystemExit(f"error: {e}")
         register_http(service)
+        # readiness rides /readyz from here on: while prewarm below is
+        # still compiling, a router probing /readyz sees 503 warming
+        # (and /query answers degraded from the scalar oracle);
+        # /healthz stays pure liveness
+        from ..telemetry.server import register_readiness
+
+        register_readiness(service.readiness)
         print(
             f"serve: metrics on {srv.url}/metrics, state on "
-            f"{srv.url}/state, queries on {srv.url}/query "
-            f"(port {srv.port})",
+            f"{srv.url}/state, queries on {srv.url}/query, readiness "
+            f"on {srv.url}/readyz (port {srv.port})",
+            file=sys.stderr,
+        )
+    if prewarm_on:
+        pw = service.prewarm()
+        aot = pw.get("aot_cache") or {}
+        print(
+            f"serve: prewarmed {pw['programs']} pair buckets in "
+            f"{pw['seconds']}s (aot adopted={aot.get('adopted')} "
+            f"compiles={aot.get('compiles')})"
+            + (f" — prewarm error: {pw['error']}" if pw.get("error") else ""),
             file=sys.stderr,
         )
     st = service.state()

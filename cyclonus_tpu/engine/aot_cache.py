@@ -1,0 +1,358 @@
+"""Persistent AOT executable cache (docs/DESIGN.md "Cold start & chaos").
+
+The JAX persistent compilation cache (engine/__init__.py) already skips
+the XLA *compile* on a warm restart, but a fresh process still pays the
+full Python *trace* of every program plus the cache's own lookup
+machinery — at the bench shape that trace+lookup residue is seconds of
+the 7.2s warmup, and it recurs for every compiled program family.  This
+module goes the rest of the way: compiled executables are SERIALIZED
+(jax.experimental.serialize_executable — the loaded binary, not the
+StableHLO) keyed by
+
+    (program name, arg shape/dtype signature = the shape bucket,
+     mesh signature, schedule, dtype plan / pack)
+
+so a restarted process ADOPTS the executable with zero traces and zero
+compiles — the AOT_COMPILES counter stays flat, which is exactly what
+tests/test_aot_cache.py's subprocess restart gate asserts.
+
+Robustness contract (the engine/autotune.py discipline): the cache is
+advisory.  A corrupt, truncated, version-skewed, wrong-key, or
+concurrently-replaced entry degrades to a fresh trace+compile — load
+NEVER raises — and a failed write is a logged warning.  Every entry is
+its own file written atomically (tmp + os.replace), so concurrent
+processes warming different programs can never clobber each other and a
+reader can never observe a half-written entry; same-key racers both
+wrote a valid executable and the last one wins.  Entries embed the full
+key plus CACHE_VERSION and the jax/backend stamp: a jaxlib upgrade or a
+different device kind silently invalidates instead of loading an
+executable the runtime cannot run.
+
+Security note: entries are pickles (the serialize_executable payload
+format), loaded only from the user's own cache directory — the same
+trust boundary as the autotune cache and JAX's own compilation cache.
+
+CYCLONUS_AOT_CACHE: cache directory; "0"/"" disables entirely (the test
+suite default — tests/conftest.py — so suites never share executables
+through the developer's home); unset -> the per-user default below.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: bump when the entry layout changes: stale versions are ignored
+#: (fresh compile), never migrated
+CACHE_VERSION = 1
+
+_DEFAULT_DIR = os.path.join("~", ".cache", "cyclonus_tpu", "aot")
+
+
+def cache_dir() -> Optional[str]:
+    """Resolved cache directory, or None when persistence is disabled."""
+    raw = os.environ.get("CYCLONUS_AOT_CACHE")
+    if raw is None:
+        raw = _DEFAULT_DIR
+    raw = raw.strip()
+    if raw in ("", "0"):
+        return None
+    return os.path.expanduser(raw)
+
+
+def platform_stamp() -> str:
+    """The (jax version, backend, device kind, device count) stamp an
+    entry must match to load: a serialized executable is a binary for
+    one runtime on one device topology — skew means recompile, never a
+    load attempt that the runtime rejects (or worse, misruns)."""
+    import jax
+
+    devs = jax.devices()
+    return (
+        f"jax={jax.__version__};backend={jax.default_backend()};"
+        f"kind={devs[0].device_kind};n={len(devs)}"
+    )
+
+
+def make_key(
+    name: str,
+    signature: str,
+    *,
+    schedule: str = "single",
+    plan: str = "",
+) -> str:
+    """Stable string key for one executable: the program NAME, the arg
+    shape/dtype SIGNATURE (the shape bucket — bucketing is what makes
+    two processes lower byte-identical programs), the mesh/platform
+    stamp, the exchange SCHEDULE (single / ring / allgather), and the
+    dtype PLAN (packed32 / int8 / bf16 + any per-engine extras)."""
+    return json.dumps(
+        {
+            "name": name,
+            "sig": signature,
+            "platform": platform_stamp(),
+            "schedule": schedule,
+            "plan": plan,
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def _entry_path(base: str, key: str) -> str:
+    d = hashlib.sha256(key.encode("utf-8")).hexdigest()[:32]
+    return os.path.join(base, f"{d}.aotx")
+
+
+def digest(obj) -> str:
+    """Stable short digest of `repr(obj)` — THE helper for folding
+    program identity the arg shapes can't see (unpack leaf metas,
+    partition-spec structures) into a cache key's plan.  One
+    implementation on purpose: the digest width/encoding is part of
+    the key, so changing it is a cache-invalidation event that must
+    happen in exactly one place."""
+    return hashlib.sha256(repr(obj).encode("utf-8")).hexdigest()[:16]
+
+
+def load(key: str):
+    """The deserialized, loaded executable for `key`, or None (disabled
+    / missing / corrupt / version-skewed / key-collided / any
+    deserialization failure).  Never raises."""
+    base = cache_dir()
+    if base is None:
+        return None
+    path = _entry_path(base, key)
+    try:
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+    except FileNotFoundError:
+        return None
+    except Exception:
+        # truncated pickle, chmod surprise, poisoned bytes: all degrade
+        # to a fresh compile (the chaos harness injects exactly this)
+        _count("corrupt")
+        return None
+    try:
+        if (
+            not isinstance(entry, dict)
+            or entry.get("v") != CACHE_VERSION
+            or entry.get("key") != key  # digest collision or stale stamp
+        ):
+            _count("stale")
+            return None
+        from jax.experimental import serialize_executable as se
+
+        return se.deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"]
+        )
+    except Exception as e:
+        # e.g. jaxlib CPU "Symbols not found" for some fusion patterns
+        # when an executable crosses processes: degrade to a fresh
+        # compile.  Truncated message — the full symbol list is noise.
+        _count("corrupt")
+        log.info(
+            "aot cache entry unloadable (%s): %s", path, str(e)[:160]
+        )
+        return None
+
+
+def store(key: str, compiled) -> bool:
+    """Serialize `compiled` under `key` (atomic tmp + os.replace).
+    Returns True when written; any failure — an executable kind the
+    backend cannot serialize (pallas custom calls on some runtimes),
+    a full disk — logs and returns False, never raising into the
+    evaluation that just compiled a perfectly good program."""
+    base = cache_dir()
+    if base is None:
+        return False
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        entry = {
+            "v": CACHE_VERSION,
+            "key": key,
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        os.makedirs(base, exist_ok=True)
+        path = _entry_path(base, key)
+        fd, tmp = tempfile.mkstemp(dir=base, prefix=".aot-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(entry, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _count("store")
+        return True
+    except Exception as e:
+        _count("unserializable")
+        log.info("aot cache store failed for %s: %s", key[:120], e)
+        return False
+
+
+def _count(outcome: str) -> None:
+    from ..telemetry import instruments as ti
+
+    ti.AOT_CACHE.inc(outcome=outcome)
+
+
+def counters() -> Dict[str, Any]:
+    """The per-process AOT cache forensics bench.py records as
+    detail.cold_start.aot_cache: hits (executables adopted from disk —
+    `adopted` aliases it for the acceptance schema), misses, stores,
+    and fresh compiles actually paid (the restart gate's flat line)."""
+    from ..telemetry import instruments as ti
+
+    return {
+        "hits": int(ti.AOT_CACHE.value(outcome="hit")),
+        "misses": int(ti.AOT_CACHE.value(outcome="miss")),
+        "adopted": int(ti.AOT_CACHE.value(outcome="hit")),
+        "stores": int(ti.AOT_CACHE.value(outcome="store")),
+        "corrupt": int(ti.AOT_CACHE.value(outcome="corrupt")),
+        "compiles": int(ti.AOT_COMPILES.value()),
+        "dir": cache_dir(),
+    }
+
+
+def _leaf_sig(leaf) -> Tuple:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(int(d) for d in shape), str(dtype))
+    # non-array leaf (None never reaches here — it is a pytree node):
+    # a python scalar lowers as a weak-typed literal, so its TYPE is
+    # part of the program identity but its value is not
+    return ("p", type(leaf).__name__)
+
+
+def call_key(args: tuple, kwargs: dict):
+    """Hashable shape/dtype key of a call's argument pytree — the
+    per-dispatch fast path (a treedef + leaf-sig tuple; no string
+    building on the hot path).  `signature_string` renders it for the
+    persisted key only when a call actually needs resolving."""
+    from jax import tree_util as jtu
+
+    leaves, treedef = jtu.tree_flatten((args, kwargs))
+    return (treedef, tuple(_leaf_sig(x) for x in leaves))
+
+
+def signature_string(key) -> str:
+    """The stable string form of a call_key — the shape-bucket half of
+    the persisted cache key."""
+    treedef, leaf_sigs = key
+    return json.dumps(
+        [str(treedef)] + [list(s) for s in leaf_sigs],
+        separators=(",", ":"),
+    )
+
+
+class AotProgram:
+    """Wrap a jitted callable with the persistent executable cache.
+
+    On the first call per argument signature: try to ADOPT a serialized
+    executable (zero trace, zero compile); otherwise lower+compile via
+    the wrapped jit (counted in AOT_COMPILES) and persist the result.
+    Later calls with the same signature dispatch the resolved
+    executable directly.  Any failure anywhere — an unserializable
+    program, a runtime that rejects the AOT path, statics the lowering
+    chokes on — pins a per-signature FALLBACK to the plain jitted
+    callable, so the wrapper can never be less robust than the jit it
+    wraps.
+
+    Not thread-safe by design: engines issue evaluations from one
+    thread at a time (api.py threading model), and the abandoned-
+    autotune orphan only ever calls through programs resolved earlier
+    on the issuing thread (dict reads are atomic under the GIL; the
+    worst interleaving resolves the same signature twice, both valid).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        jitted,
+        *,
+        plan: str = "",
+        schedule: str = "single",
+        static_argnames: Tuple[str, ...] = (),
+    ):
+        self._name = name
+        self._jitted = jitted
+        self._plan = plan
+        self._schedule = schedule
+        self._static_argnames = tuple(static_argnames)
+        # (call_key, statics) -> compiled | None(=fallback); keyed by
+        # the hashable tuple so steady-state dispatches never build a
+        # signature string
+        self._programs: Dict[Any, Any] = {}
+
+    def _cache_size(self) -> int:
+        """Trace-cache size of the wrapped jit — the zero-recompile
+        elastic-resize gates read this through the program caches.
+        Adopted executables never trace, so they never count."""
+        return self._jitted._cache_size()
+
+    def __call__(self, *args, **kwargs):
+        if cache_dir() is None:
+            return self._jitted(*args, **kwargs)
+        statics = tuple(
+            (k, kwargs[k]) for k in self._static_argnames if k in kwargs
+        )
+        dyn_kwargs = {
+            k: v for k, v in kwargs.items() if k not in self._static_argnames
+        }
+        key = (call_key(args, dyn_kwargs), statics)
+        if key not in self._programs:
+            sig = signature_string(key[0]) + "|" + repr(statics)
+            self._programs[key] = self._resolve(sig, args, kwargs)
+        compiled = self._programs[key]
+        if compiled is None:
+            return self._jitted(*args, **kwargs)
+        try:
+            return compiled(*args, **dyn_kwargs)
+        except Exception:
+            # a loaded executable the runtime rejects at CALL time
+            # (device moved, donation mismatch): fall back for good
+            _count("call_fallback")
+            self._programs[key] = None
+            return self._jitted(*args, **kwargs)
+
+    def _resolve(self, sig: str, args, kwargs):
+        from ..telemetry import instruments as ti
+
+        key = make_key(
+            self._name, sig, schedule=self._schedule, plan=self._plan
+        )
+        try:
+            compiled = load(key)
+        except Exception:  # belt and braces: load already never raises
+            compiled = None
+        if compiled is not None:
+            ti.AOT_CACHE.inc(outcome="hit")
+            return compiled
+        ti.AOT_CACHE.inc(outcome="miss")
+        try:
+            compiled = self._jitted.lower(*args, **kwargs).compile()
+            ti.AOT_COMPILES.inc()
+        except Exception as e:
+            # lowering surprises (unsupported statics, tracer leaks in
+            # exotic paths) must not break evaluation: plain jit from
+            # here on for this signature
+            log.info("aot lower/compile fallback for %s: %s", self._name, e)
+            ti.AOT_CACHE.inc(outcome="fallback")
+            return None
+        store(key, compiled)
+        return compiled

@@ -21,6 +21,7 @@ from ..matcher.core import Policy
 from ..telemetry import instruments as ti
 from ..utils import guards
 from ..utils.tracing import phase
+from . import aot_cache
 from .encoding import (
     PEER_IP,
     PolicyEncoding,
@@ -904,6 +905,11 @@ class TpuPolicyEngine:
         # compiled program set is a function of it, like the operand
         # dtype — and passed static everywhere
         self._pack = pack_enabled()
+        # persistent AOT executable adapters (engine/aot_cache.py):
+        # built lazily per program family; with CYCLONUS_AOT_CACHE off
+        # they pass straight through to the plain jits
+        self._grid_aot = None
+        self._pairs_aot = None
         # the tuned counts configuration: None until the autotune (or a
         # persisted-cache adoption) picks one; then {"kernel":
         # "default"|"slab"|"packed", optional "bs"/"bd"}.  Shares
@@ -1002,6 +1008,32 @@ class TpuPolicyEngine:
         # re-uploaded lazily (a touched index vector, not a slab)
         self._pod_perm_dev = None
         self._pod_perm_host = None
+
+    def _aot_plan(self, extra: str = "") -> str:
+        """The dtype-plan half of the persistent AOT executable key
+        (engine/aot_cache.py): packed32 vs the dense operand dtype plus
+        the tier flag.  Programs whose trace bakes per-engine constants
+        (the unpack closures' leaf layout) append a metas digest via
+        `extra` — two engines with equal buffer lengths but different
+        leaf layouts must never share an executable."""
+        from .pallas_kernel import _resolve_operand_dtype
+
+        dtype = "packed32" if self._pack else _resolve_operand_dtype(None)
+        plan = f"{dtype};tiered={self.tiers is not None}"
+        return plan + (";" + extra if extra else "")
+
+    @staticmethod
+    def _metas_digest(unpack) -> str:
+        """Stable digest of a _pack_tensors unpack closure's baked leaf
+        layout ((dtype, shape, word offset) per path) — the part of an
+        unpack-consuming program's identity the arg shapes alone can't
+        see."""
+        return aot_cache.digest(sorted(unpack.metas_by_path.items()))
+
+    def aot_stats(self) -> Dict:
+        """The per-process AOT executable-cache forensics (bench.py
+        records them under detail.cold_start.aot_cache)."""
+        return aot_cache.counters()
 
     def _build_tensors(self) -> Dict:
         enc = self.encoding
@@ -1161,7 +1193,13 @@ class TpuPolicyEngine:
                     "_class_packed_buf", "_class_unpack", st["ctensors"]
                 )
                 if self._class_unpack_jit is None:
-                    self._class_unpack_jit = jax.jit(self._class_unpack)
+                    self._class_unpack_jit = aot_cache.AotProgram(
+                        "unpack.classes",
+                        jax.jit(self._class_unpack),
+                        plan=self._aot_plan(
+                            self._metas_digest(self._class_unpack)
+                        ),
+                    )
                 self._class_device_tensors = self._class_unpack_jit(buf)
             tensors = dict(self._class_device_tensors)
         else:
@@ -1271,10 +1309,14 @@ class TpuPolicyEngine:
                     )
             if self._class_grid_jit is None:
                 pack = self._pack
-                self._class_grid_jit = jax.jit(
-                    lambda t, co: gather_class_grids(
-                        evaluate_grid_kernel(t, pack=pack), co
-                    )
+                self._class_grid_jit = aot_cache.AotProgram(
+                    "grid.classes",
+                    jax.jit(
+                        lambda t, co: gather_class_grids(
+                            evaluate_grid_kernel(t, pack=pack), co
+                        )
+                    ),
+                    plan=self._aot_plan(),
                 )
             t0 = time.perf_counter()
             with phase("engine.dispatch"):
@@ -1390,13 +1432,20 @@ class TpuPolicyEngine:
         if self._class_state is not None:
             return self._evaluate_grid_classes(cases)
         n = self.encoding.cluster.n_pods
+        if self._grid_aot is None:
+            self._grid_aot = aot_cache.AotProgram(
+                "grid",
+                evaluate_grid_kernel,
+                plan=self._aot_plan(),
+                static_argnames=("pack",),
+            )
         with ti.eval_flight("grid", n, len(cases), dispatch_only=True):
             tensors = self._tensors_with_cases(cases, device=True)
             # dispatch-only timing: jit calls return once enqueued (async);
             # device execution time lands in grid.fetch / allow_stats
             t0 = time.perf_counter()
             with phase("engine.dispatch"):
-                out = evaluate_grid_kernel(tensors, pack=self._pack)
+                out = self._grid_aot(tensors, pack=self._pack)
             if self.tiers is not None:
                 self._tier_resolve_s = time.perf_counter() - t0
         # kernel emits [q, ...] layout directly: one device execution
@@ -1439,7 +1488,11 @@ class TpuPolicyEngine:
             if self._device_tensors is None:
                 buf = self._ensure_packed()
                 if self._unpack_jit is None:
-                    self._unpack_jit = jax.jit(self._unpack)
+                    self._unpack_jit = aot_cache.AotProgram(
+                        "unpack",
+                        jax.jit(self._unpack),
+                        plan=self._aot_plan(self._metas_digest(self._unpack)),
+                    )
                 self._device_tensors = self._unpack_jit(buf)
             tensors = dict(self._device_tensors)
         else:
@@ -2023,7 +2076,7 @@ class TpuPolicyEngine:
                 "candidates": [],
             }
             return self._counts_from_pre_packed_jit(
-                pre, n32, choice["bs"], choice["bd"]
+                pre, n32, bs=choice["bs"], bd=choice["bd"]
             )
         if at.cache_path() is not None:
             ti.AUTOTUNE_CACHE.inc(outcome="miss")
@@ -2038,7 +2091,9 @@ class TpuPolicyEngine:
                     {"kernel": "packed", "bs": cands[0][0], "bd": cands[0][1]}
                 ],
             }
-            return self._counts_from_pre_packed_jit(pre, n32, *cands[0])
+            return self._counts_from_pre_packed_jit(
+                pre, n32, bs=cands[0][0], bd=cands[0][1]
+            )
 
         ti.AUTOTUNE_SEARCHES.inc()
         t_search0 = _time.perf_counter()
@@ -2052,7 +2107,7 @@ class TpuPolicyEngine:
             def leg(_bs=bs, _bd=bd):
                 return self._timed_rounds(
                     lambda: self._counts_from_pre_packed_jit(
-                        pre, n32, _bs, _bd
+                        pre, n32, bs=_bs, bd=_bd
                     )
                 )
 
@@ -2231,15 +2286,33 @@ class TpuPolicyEngine:
             )
             return counts_from_pre(pre, n_pods, t0_e, t0_i)
 
-        self._counts_packed_jit = counts_packed
-        self._pre_jit = jax.jit(
-            lambda buf, perm, qp, qn, qr: _precompute(
-                prepared_tensors(buf, perm, qp, qn, qr), pack
-            )
+        # every program below rides the persistent AOT executable cache
+        # (engine/aot_cache.py): a restarted process adopts serialized
+        # executables — zero trace, zero compile — and any program the
+        # runtime can't serialize falls back to the plain jit.  The
+        # fused/pre programs bake the unpack closure's leaf layout into
+        # their trace, so their cache key carries the metas digest.
+        unpack_plan = self._aot_plan(self._metas_digest(unpack))
+        self._counts_packed_jit = aot_cache.AotProgram(
+            "counts.fused", counts_packed, plan=unpack_plan
         )
-        self._counts_from_pre_jit = jax.jit(counts_from_pre)
-        self._counts_from_pre_packed_jit = jax.jit(
-            counts_from_pre_packed, static_argnames=("bs", "bd")
+        self._pre_jit = aot_cache.AotProgram(
+            "counts.pre",
+            jax.jit(
+                lambda buf, perm, qp, qn, qr: _precompute(
+                    prepared_tensors(buf, perm, qp, qn, qr), pack
+                )
+            ),
+            plan=unpack_plan,
+        )
+        self._counts_from_pre_jit = aot_cache.AotProgram(
+            "counts.from_pre", jax.jit(counts_from_pre), plan=self._aot_plan()
+        )
+        self._counts_from_pre_packed_jit = aot_cache.AotProgram(
+            "counts.from_pre_packed",
+            jax.jit(counts_from_pre_packed, static_argnames=("bs", "bd")),
+            plan=self._aot_plan(),
+            static_argnames=("bs", "bd"),
         )
 
         def slab_ops(pre, n_pods, t0_e, t0_i, w=None):
@@ -2517,7 +2590,7 @@ class TpuPolicyEngine:
             and "bs" in choice
         ):
             return self._counts_from_pre_packed_jit(
-                self._pre_cache[1], n32, choice["bs"], choice["bd"]
+                self._pre_cache[1], n32, bs=choice["bs"], bd=choice["bd"]
             )
         return self._counts_from_pre_jit(self._pre_cache[1], n32, None, None)
 
@@ -2702,10 +2775,16 @@ class TpuPolicyEngine:
         if not cases or len(pairs) == 0:
             return np.zeros((len(pairs), len(cases), 3), dtype=bool)
         idx = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
+        if self._pairs_aot is None:
+            # the serve query path's program: a restarted serve replica
+            # adopts it from the AOT cache before its first verdict
+            self._pairs_aot = aot_cache.AotProgram(
+                "pairs", evaluate_pairs_kernel, plan=self._aot_plan()
+            )
         with ti.eval_flight(
             "pairs", self.encoding.cluster.n_pods, len(cases), k=len(pairs)
         ):
-            out = evaluate_pairs_kernel(
+            out = self._pairs_aot(
                 self._tensors_with_cases(cases, device=True), idx[:, 0], idx[:, 1]
             )
         return np.stack(

@@ -460,6 +460,26 @@ def _sharded_program(
                 body, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
             )
         )
+        # the persistent AOT executable cache covers the cached sharded
+        # programs too (engine/aot_cache.py): a restarted process
+        # adopts the ring/allgather executables for its mesh without a
+        # retrace.  The partition-spec structure and the shard/pack
+        # statics are program identity the arg shapes can't see, so
+        # they ride in the plan.
+        from . import aot_cache
+
+        spec_digest = aot_cache.digest(
+            (str(treedef), [str(x) for x in leaves])
+        )
+        fn = aot_cache.AotProgram(
+            "sharded.grid",
+            fn,
+            schedule=schedule,
+            plan=(
+                f"shard={shard};pack={pack};"
+                f"mesh={','.join(mesh.axis_names)}x{n_dev};{spec_digest}"
+            ),
+        )
         if len(_SHARDED_PROGRAMS) >= _SHARDED_PROGRAMS_MAX:
             _SHARDED_PROGRAMS.clear()  # crude bound; programs re-jit
         _SHARDED_PROGRAMS[key] = fn

@@ -156,6 +156,25 @@ def _bench_run_from_parsed(
     cold = detail.get("cold_start") or detail.get("retries") or {}
     if isinstance(cold, dict):
         run.retries = dict(cold)
+        # persistent AOT executable-cache forensics: adopted > 0 is the
+        # cache-bearing marker that arms the sentinel's HARD warmup
+        # bound (older artifacts carry no aot_cache block and keep the
+        # relative warn-tolerance bound)
+        aot = cold.get("aot_cache")
+        if isinstance(aot, dict):
+            for src, dst in (
+                ("hits", "aot_hits"),
+                ("misses", "aot_misses"),
+                ("adopted", "aot_adopted"),
+                ("compiles", "aot_compiles"),
+            ):
+                if isinstance(aot.get(src), int):
+                    setattr(run, dst, int(aot[src]))
+    chaos = detail.get("chaos")
+    if isinstance(chaos, dict) and isinstance(
+        chaos.get("ttfv_s"), (int, float)
+    ):
+        run.chaos_ttfv_s = float(chaos["ttfv_s"])
     cc = detail.get("class_compression")
     if isinstance(cc, dict) and isinstance(cc.get("ratio"), (int, float)):
         run.class_compression_ratio = float(cc["ratio"])

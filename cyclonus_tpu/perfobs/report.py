@@ -58,6 +58,19 @@ PERF_ROOFLINE_EFFICIENCY = REGISTRY.gauge(
     "pack-bearing runs.",
     labelnames=("run",),
 )
+PERF_AOT_ADOPTED = REGISTRY.gauge(
+    "cyclonus_tpu_perf_aot_adopted",
+    "Ledger: serialized AOT executables a run adopted at cold start "
+    "(detail.cold_start.aot_cache.adopted); > 0 marks the run "
+    "cache-bearing, which hard-gates its warmup_s.",
+    labelnames=("run",),
+)
+PERF_CHAOS_TTFV = REGISTRY.gauge(
+    "cyclonus_tpu_perf_chaos_ttfv_seconds",
+    "Ledger: time-to-first-verdict of the chaos kill/restart leg "
+    "(detail.chaos.ttfv_s; hard-bounded inside the bench leg).",
+    labelnames=("run",),
+)
 PERF_RUNS = REGISTRY.gauge(
     "cyclonus_tpu_perf_runs",
     "Ledger: ingested runs by failure class.",
@@ -92,6 +105,10 @@ def publish(ledger: Ledger, result: Optional[GateResult] = None) -> None:
             PERF_ROOFLINE_EFFICIENCY.set(
                 run.roofline_efficiency, run=run.run_id
             )
+        if run.aot_adopted is not None:
+            PERF_AOT_ADOPTED.set(run.aot_adopted, run=run.run_id)
+        if run.chaos_ttfv_s is not None:
+            PERF_CHAOS_TTFV.set(run.chaos_ttfv_s, run=run.run_id)
         if run.failure_class == "ok":
             best = max(best, run.cells_per_sec)
     for run in ledger.runs:
@@ -188,7 +205,8 @@ def render_markdown(
         lines.append(
             f"| {r.run_id} | {r.kind} | {r.failure_class} "
             f"| {_human_rate(r.cells_per_sec) if r.cells_per_sec else '-'} "
-            f"| {r.warmup_s if r.warmup_s is not None else '-'} "
+            f"| {r.warmup_s if r.warmup_s is not None else '-'}"
+            f"{' (aot)' if r.aot_adopted else ''} "
             f"| {per_chip} | {ratio} | {eff} | {note} |"
         )
     by_class = ledger.counts_by_class()
@@ -211,6 +229,13 @@ def render_markdown(
             bw = min(warm, key=lambda r: r.warmup_s)
             lines.append(
                 f"- best warmup: {bw.warmup_s}s ({bw.run_id})"
+            )
+        ttfv = [r for r in ok_runs if r.chaos_ttfv_s is not None]
+        if ttfv:
+            bt = min(ttfv, key=lambda r: r.chaos_ttfv_s)
+            lines.append(
+                f"- best chaos restart time-to-first-verdict: "
+                f"{bt.chaos_ttfv_s}s ({bt.run_id})"
             )
     if result is not None:
         lines += ["", "## Gate", "", "```", result.report(), "```"]

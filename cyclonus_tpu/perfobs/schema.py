@@ -112,6 +112,21 @@ class PerfRun:
     pack_tile: Optional[List[int]] = None  # tuned [bs, bd] winner
     pack_search_s: Optional[float] = None
     pack_candidates: Optional[int] = None
+    # detail.cold_start.aot_cache — persistent AOT executable-cache
+    # forensics (None: older artifact).  aot_adopted > 0 marks a
+    # CACHE-BEARING run: the sentinel graduates warmup_s from the
+    # warn-tolerance relative bound to a HARD absolute bound on exactly
+    # these runs (a restarted process that adopted its executables has
+    # no compile storm left to excuse a long warmup).
+    aot_hits: Optional[int] = None
+    aot_misses: Optional[int] = None
+    aot_adopted: Optional[int] = None
+    aot_compiles: Optional[int] = None
+    # detail.chaos — the serve kill/restart leg's time-to-first-verdict
+    # (None: leg skipped or an older artifact).  Warn-only in the
+    # sentinel (new fields ride warn-only first); the bench leg itself
+    # hard-bounds it via CYCLONUS_CHAOS_TTFV_S.
+    chaos_ttfv_s: Optional[float] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -149,6 +164,11 @@ class PerfRun:
             "pack_tile": self.pack_tile,
             "pack_search_s": self.pack_search_s,
             "pack_candidates": self.pack_candidates,
+            "aot_hits": self.aot_hits,
+            "aot_misses": self.aot_misses,
+            "aot_adopted": self.aot_adopted,
+            "aot_compiles": self.aot_compiles,
+            "chaos_ttfv_s": self.chaos_ttfv_s,
             "error": self.error,
             "metric": self.metric,
         }

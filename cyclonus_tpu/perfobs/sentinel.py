@@ -12,6 +12,8 @@ Gate posture, in order of precedence for the candidate (latest) run:
      the last N prior HEALTHY runs only:
        - cells_per_sec   >= best-of-N * (1 - rate_tol)
        - warmup_s        <= best-of-N * (1 + warmup_tol) + warmup_slack
+                         (HARD absolute ceiling warmup_cached_max_s
+                         instead on cache-bearing runs: aot_adopted > 0)
        - each phase      <= best-of-N * (1 + phase_tol) + phase_slack
        - scaling         cells_per_sec_per_chip / single-chip best
                          >= min_scaling_efficiency (real meshes only:
@@ -46,7 +48,8 @@ from .schema import PerfRun
 #: and tiers likewise rides warn-only (tiers_resolve_s; BENCH_TIERS_*
 #: knobs shape the leg)
 _DEDICATED_PHASES = frozenset(
-    {"warmup", "eval", "backend_init_join", "serve_churn", "tiers"}
+    {"warmup", "eval", "backend_init_join", "serve_churn", "tiers",
+     "chaos"}
 )
 
 
@@ -146,6 +149,7 @@ def gate(
     phase_slack_s: float = 2.0,
     min_scaling_efficiency: float = 0.5,
     min_roofline_efficiency: float = 0.7,
+    warmup_cached_max_s: float = 5.0,
     candidate: Optional[PerfRun] = None,
 ) -> GateResult:
     """Gate the candidate (default: latest bench run) against the
@@ -278,6 +282,54 @@ def gate(
                 baseline_runs=base_ids,
             )
         )
+    # --- warmup on CACHE-BEARING runs: graduated to a HARD bound ---------
+    # a run whose detail.cold_start.aot_cache (snapshotted at END OF
+    # WARMUP — later bench legs adopting the process's own stores must
+    # not count) shows adopted executables AND zero fresh compiles
+    # restarted against a FULLY warm persistent cache: its warmup has
+    # no trace/compile storm left, so it gets an ABSOLUTE ceiling
+    # (warmup_cached_max_s) instead of the tolerance-padded relative
+    # bound above — the cold-start acceptance criterion.  A half-warm
+    # cache (adopted > 0 but compiles > 0) legitimately pays some
+    # compiles and keeps the relative posture, as do legacy artifacts
+    # with no aot_cache block at all.
+    if (
+        isinstance(candidate.aot_adopted, int)
+        and candidate.aot_adopted > 0
+        and (candidate.aot_compiles or 0) == 0
+        and isinstance(candidate.warmup_s, (int, float))
+    ):
+        deltas.append(
+            Delta(
+                metric="warmup_s[aot-cached]",
+                candidate=candidate.warmup_s,
+                baseline=warmup_cached_max_s,
+                bound=warmup_cached_max_s,
+                regressed=candidate.warmup_s > warmup_cached_max_s,
+                direction="max",
+                baseline_runs=[candidate.run_id],
+            )
+        )
+
+    # --- chaos restart leg: WARN, never fail ----------------------------
+    # time-to-first-verdict after a kill/restart is hard-bounded INSIDE
+    # the bench leg (CYCLONUS_CHAOS_TTFV_S raises there); here the new
+    # field rides warn-only first, the serve-field discipline
+    ttfv_base = [
+        r.chaos_ttfv_s
+        for r in baselines
+        if isinstance(r.chaos_ttfv_s, (int, float))
+    ]
+    if ttfv_base and isinstance(candidate.chaos_ttfv_s, (int, float)):
+        best_ttfv = min(ttfv_base)
+        if candidate.chaos_ttfv_s > 2.0 * best_ttfv:
+            notes.append(
+                "WARNING: chaos time-to-first-verdict degraded >2x vs "
+                f"baseline: candidate {candidate.chaos_ttfv_s:g}s vs "
+                f"best {best_ttfv:g}s — reported only (warn, not "
+                "fail); check the AOT cache adoption path before the "
+                "next round"
+            )
 
     # --- class compression ratio: WARN, never fail ----------------------
     # the ratio is workload-shaped (a cluster with genuinely more label

@@ -30,11 +30,13 @@ empty.  Every Verdict carries the epoch it was computed at.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import chaos
 from ..engine.api import PortCase, TpuPolicyEngine, _parseable_ip
 from ..kube.netpol import NAMESPACE_DEFAULT, NetworkPolicy
 from ..kube.yaml_io import parse_policy_dict
@@ -75,6 +77,16 @@ def _churn_frac_limit() -> float:
         return float(os.environ.get("CYCLONUS_SERVE_CHURN_FRAC", "0.25"))
     except ValueError:
         return 0.25
+
+
+def _prewarm_pair_cap() -> int:
+    """Largest power-of-two pair bucket prewarm compiles (the query
+    path pads batches to pow2, so buckets 1..cap cover every batch up
+    to cap).  CYCLONUS_SERVE_PREWARM_PAIRS overrides; default 64."""
+    try:
+        return int(os.environ.get("CYCLONUS_SERVE_PREWARM_PAIRS", "64"))
+    except ValueError:
+        return 64
 
 
 def histogram_quantile(snapshot: Dict, q: float) -> Optional[float]:
@@ -167,8 +179,21 @@ class VerdictService:
         simplify: bool = True,
         class_compress: Optional[str] = None,
         tiers: Optional[TierSet] = None,
+        defer_ready: bool = False,
     ):
         self._lock = guards.lock()
+        # readiness (docs/DESIGN.md "Cold start & chaos"): warming is
+        # not ready.  A thread-safe Event, not a Guarded field — the
+        # /readyz callback and the query router read it lock-free while
+        # prewarm compiles for seconds.  defer_ready=True starts the
+        # service WARMING: queries answer from the scalar-oracle
+        # authoritative-state fallback (counted in
+        # cyclonus_tpu_serve_degraded_queries_total) until prewarm()
+        # or mark_ready() flips it.  Default False keeps the historical
+        # ready-at-construction behavior for batch/test callers.
+        self._ready = threading.Event()
+        if not defer_ready:
+            self._ready.set()
         self._simplify = simplify
         self._class_compress = class_compress
         self.pods: Dict[str, PodTuple] = {
@@ -484,6 +509,12 @@ class VerdictService:
                     op = self._apply_to_state(d, pol)
                     if op is not None:
                         ops.append(op)
+                # chaos point `delta_apply`: a fault injected HERE —
+                # after the authoritative dicts mutated, before the
+                # engine saw anything — must ride the same rollback +
+                # rebuild-to-snapshot recovery a real mid-apply crash
+                # takes (chaos/harness.py scenario delta_drop)
+                chaos.fire("delta_apply")
                 if not ops:
                     self._counts["noop"] += 1
                     ti.SERVE_APPLIES.inc(mode="noop")
@@ -640,13 +671,96 @@ class VerdictService:
         inc.finish()
         return mode
 
+    # --- readiness / prewarm ----------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        """False while the replica is still warming its compiled-program
+        set (the /readyz answer; warming != live)."""
+        return self._ready.is_set()
+
+    def mark_ready(self) -> None:
+        self._ready.set()
+
+    def readiness(self) -> Tuple[bool, str]:
+        """The (ready, detail) pair telemetry/server.py's /readyz route
+        consumes."""
+        if self._ready.is_set():
+            return True, f"serving at epoch {self.epoch}"
+        return False, "prewarming compiled programs (queries degrade to the scalar oracle)"
+
+    def prewarm(
+        self,
+        pair_buckets: Optional[Sequence[int]] = None,
+        case: PortCase = VERIFY_CASES[0],
+    ) -> Dict:
+        """Warm the query path's compiled-program bucket set BEFORE the
+        replica marks itself ready: the packed-buffer transfer + unpack
+        program, then one evaluate_pairs per power-of-two pair bucket
+        (the exact programs pow2-padded query batches dispatch; port-
+        case VALUES don't change the program, so one case warms them
+        all).  With a warm persistent AOT cache every program is
+        ADOPTED — zero traces, zero compiles — which is what makes a
+        restarted replica's time-to-first-verdict a transfer, not a
+        compile storm.  Marks the service ready on completion (or on
+        failure: a replica that cannot prewarm still serves, it just
+        pays its compiles on the query path) and returns the forensics.
+
+        Runs engine evaluations OUTSIDE self._lock on purpose: the
+        delta stream starts only after prewarm returns (cli/serve_cmd
+        ordering), and holding the lock through seconds of compile
+        would block the degraded query path this warmup phase exists
+        to keep responsive."""
+        t0 = time.perf_counter()
+        with self._lock:
+            eng = self._inc.engine
+            n = eng.encoding.cluster.n_pods
+        if pair_buckets is None:
+            cap = max(1, _prewarm_pair_cap())
+            pair_buckets = []
+            k = 1
+            while k <= cap:
+                pair_buckets.append(k)
+                k *= 2
+        programs = 0
+        error = None
+        try:
+            if n > 0:
+                for k in pair_buckets:
+                    eng.evaluate_pairs([case], [(0, 0)] * int(k))
+                    programs += 1
+        except Exception as e:  # degraded is better than dead
+            error = f"{type(e).__name__}: {e}"
+        finally:
+            self.mark_ready()
+        aot = eng.aot_stats()
+        return {
+            "seconds": round(time.perf_counter() - t0, 3),
+            "programs": programs,
+            "pair_buckets": [int(k) for k in pair_buckets],
+            "pods": n,
+            "error": error,
+            "aot_cache": {
+                k: aot.get(k)
+                for k in ("hits", "misses", "adopted", "compiles")
+            },
+        }
+
     # --- queries ----------------------------------------------------------
 
     def query(self, queries: Sequence[FlowQuery]) -> List[Verdict]:
         """Answer a batch of flow queries from the live engine: one
         evaluate_pairs dispatch per distinct port case, pair counts
         padded to powers of two so the compiled-program set stays
-        bounded under arbitrary batch sizes."""
+        bounded under arbitrary batch sizes.
+
+        While the service is still WARMING (defer_ready + prewarm in
+        flight), queries answer from the scalar-oracle authoritative-
+        state fallback instead — exact verdicts at host speed, counted
+        in cyclonus_tpu_serve_degraded_queries_total — so a fleet
+        router that ignores /readyz still gets correct answers."""
+        if not self._ready.is_set():
+            return self._query_degraded(queries)
         t0 = time.perf_counter()
         with self._lock:
             # host-side span only (serve.query): no device sync inside
@@ -704,6 +818,63 @@ class VerdictService:
                 )
         return out
 
+    def _query_degraded(self, queries: Sequence[FlowQuery]) -> List[Verdict]:
+        """Warmup-window query path: compute every verdict with the
+        scalar oracle straight from the authoritative dicts (the state
+        the engine itself is built from, so answers are exact — the
+        same oracle verify_parity spot-checks against).  Host-speed
+        only; each flow is counted in
+        cyclonus_tpu_serve_degraded_queries_total so the fleet can see
+        which replicas served degraded and how much."""
+        from ..analysis.oracle import traffic_for_cell
+        from ..matcher.tiered import TieredPolicy, tiered_oracle_verdicts
+
+        t0 = time.perf_counter()
+        with self._lock:
+            pods_list = list(self.pods.values())
+            namespaces = dict(self.namespaces)
+            policy = self._policy
+            tiers = self._tier_set()
+            epoch = self._epoch
+        idx = {f"{p[0]}/{p[1]}": i for i, p in enumerate(pods_list)}
+        oracle = TieredPolicy(policy, tiers) if tiers else None
+        out: List[Verdict] = []
+        for q in queries:
+            si, di = idx.get(q.src), idx.get(q.dst)
+            if si is None or di is None:
+                missing = q.src if si is None else q.dst
+                out.append(Verdict(
+                    query=q, epoch=epoch,
+                    error=f"unknown pod key {missing!r}",
+                ))
+                continue
+            t = traffic_for_cell(
+                pods_list, namespaces,
+                PortCase(q.port, q.port_name, q.protocol), si, di,
+            )
+            want = (
+                oracle.is_traffic_allowed(t)
+                if oracle is not None
+                else tiered_oracle_verdicts(policy, None, t)
+            )
+            out.append(Verdict(
+                query=q,
+                ingress=bool(want[0]),
+                egress=bool(want[1]),
+                combined=bool(want[2]),
+                epoch=epoch,
+            ))
+        dt = time.perf_counter() - t0
+        per = dt / max(len(queries), 1)
+        for v in out:
+            if not v.error:
+                v.latency_ms = round(per * 1000.0, 4)
+        for _ in range(len(queries)):
+            ti.SERVE_QUERY_LATENCY.observe(per)
+        ti.SERVE_QUERIES.inc(len(queries))
+        ti.SERVE_DEGRADED.inc(len(queries))
+        return out
+
     # --- observability ----------------------------------------------------
 
     def _refresh_gauges(self) -> None:
@@ -748,6 +919,8 @@ class VerdictService:
             cc = eng.class_compression_stats()
             return {
                 "epoch": self._epoch,
+                "ready": self._ready.is_set(),
+                "degraded_queries": int(ti.SERVE_DEGRADED.value()),
                 "pending_deltas": pending,
                 "staleness_s": round(staleness, 3),
                 "pods": eng.encoding.cluster.n_pods,

@@ -184,6 +184,24 @@ AUTOTUNE_CACHE = REGISTRY.counter(
     labelnames=("outcome",),
 )
 
+# --- persistent AOT executable cache -------------------------------------
+
+AOT_CACHE = REGISTRY.counter(
+    "cyclonus_tpu_aot_cache_total",
+    "Persistent AOT executable-cache events by outcome: hit (serialized "
+    "executable adopted — zero trace, zero compile), miss (no entry -> "
+    "fresh lower+compile), store (executable persisted), corrupt/stale "
+    "(entry rejected -> fresh compile), unserializable (store refused "
+    "by the runtime), fallback (wrapper pinned to plain jit).",
+    labelnames=("outcome",),
+)
+AOT_COMPILES = REGISTRY.counter(
+    "cyclonus_tpu_aot_compiles_total",
+    "Fresh lower+compile passes paid by AOT-wrapped programs.  A "
+    "restarted process adopting a warm cache keeps this flat — the "
+    "zero-recompile restart contract tests/test_aot_cache.py asserts.",
+)
+
 # --- cold-start forensics ------------------------------------------------
 # Rounds 3-4 lost their scoreboard to backend/tunnel init; these count
 # every attach/probe attempt so a flaky cold start is a labeled series,
@@ -207,6 +225,19 @@ TUNNEL_PROBE_ATTEMPTS = REGISTRY.counter(
     "Bounded subprocess tunnel probes (tools/tunnel_wait.py), by "
     "outcome (alive/dead/timeout).",
     labelnames=("outcome",),
+)
+WORKER_RETRIES = REGISTRY.counter(
+    "cyclonus_tpu_worker_retries_total",
+    "Driver-side worker batch retries (worker/client.py): each one is "
+    "a batch re-issued after a timeout or exec failure, with jittered "
+    "backoff — a worker that dies mid-batch costs retries, never a "
+    "wedged driver.",
+)
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "cyclonus_tpu_chaos_injections_total",
+    "Faults injected by the chaos layer (cyclonus_tpu/chaos), by "
+    "injection point.  Nonzero only when CYCLONUS_CHAOS is armed.",
+    labelnames=("point",),
 )
 
 # --- verdict service (cyclonus_tpu/serve) --------------------------------
@@ -263,6 +294,14 @@ SERVE_HEADROOM_SAVES = REGISTRY.counter(
 SERVE_QUERIES = REGISTRY.counter(
     "cyclonus_tpu_serve_queries_total",
     "Verdict service: flow queries answered.",
+)
+SERVE_DEGRADED = REGISTRY.counter(
+    "cyclonus_tpu_serve_degraded_queries_total",
+    "Verdict service: queries answered from the scalar-oracle "
+    "authoritative-state fallback while the engine was still warming "
+    "(graceful degradation — correct verdicts at host speed, counted "
+    "so a fleet can see which replicas served degraded and for how "
+    "many flows).",
 )
 SERVE_QUERY_LATENCY = REGISTRY.histogram(
     "cyclonus_tpu_serve_query_latency_seconds",

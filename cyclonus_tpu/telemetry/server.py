@@ -10,7 +10,14 @@ it) and serves, on a daemon thread:
     /profile?seconds=N on-demand device profiling: runs jax.profiler.trace
                        for N seconds into a fresh temp dir and returns the
                        artifact path as JSON (open in TensorBoard/XProf)
-    /healthz           200 ok
+    /healthz           liveness: 200 ok whenever the process serves HTTP
+    /readyz            readiness: 200 ready / 503 warming, from the
+                       optional callback registered via
+                       register_readiness() — serve registers its
+                       prewarm state here so a fleet router can hold
+                       traffic while a replica warms; with no callback
+                       registered, readiness == liveness (the old
+                       single-answer behavior)
 
 Extension routes registered via `register_route(path, fn)` serve JSON
 from the same thread — `cyclonus-tpu serve` adds /state (engine epoch,
@@ -73,6 +80,33 @@ def _route_for(path: str):
         return _ROUTES.get(path)
 
 
+# optional readiness callback: fn() -> (ready: bool, detail: str).
+# /healthz stays pure liveness (200 whenever the thread serves); /readyz
+# consults this so probe/worker/serve each report HONEST readiness —
+# a serve replica still prewarming its executables answers 503 and a
+# fleet router holds traffic instead of routing into the warmup.
+_READINESS: dict = {"fn": None}  # guarded-by: _ROUTES_LOCK
+
+
+def register_readiness(fn) -> None:
+    """Register the process readiness callback (replaces any previous
+    one; None restores the default ready-when-alive behavior)."""
+    with _ROUTES_LOCK:
+        _READINESS["fn"] = fn
+
+
+def _readiness() -> tuple:
+    with _ROUTES_LOCK:
+        fn = _READINESS["fn"]
+    if fn is None:
+        return True, "no readiness callback registered"
+    try:
+        ready, detail = fn()
+        return bool(ready), str(detail)
+    except Exception as e:  # a broken callback reads as not-ready
+        return False, f"readiness callback failed: {type(e).__name__}: {e}"
+
+
 class _Handler(BaseHTTPRequestHandler):
     def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
         self.send_response(code)
@@ -105,7 +139,16 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/profile":
             self._profile(parse_qs(parsed.query))
         elif path == "/healthz":
+            # liveness ONLY, by contract: restart the process when this
+            # fails; readiness (warming vs serving) lives at /readyz
             self._send(b"ok\n", "text/plain")
+        elif path == "/readyz":
+            ready, detail = _readiness()
+            self._send(
+                f"{'ready' if ready else 'warming'}: {detail}\n".encode(),
+                "text/plain",
+                200 if ready else 503,
+            )
         else:
             fn = _route_for(path)
             if fn is None:

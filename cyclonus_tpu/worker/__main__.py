@@ -54,6 +54,11 @@ def main(argv=None) -> int:
             f"telemetry: metrics on {srv.url}/metrics (port {srv.port})",
             file=sys.stderr,
         )
+        # honest readiness: the worker is ready the moment it starts
+        # consuming its batch (no warmup phase of its own)
+        from ..telemetry.server import register_readiness
+
+        register_readiness(lambda: (True, "worker processing batch"))
     print(run_worker(args.jobs))
     if args.trace_out:
         from ..telemetry import trace_export

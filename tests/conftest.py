@@ -23,6 +23,11 @@ os.environ.setdefault("CYCLONUS_BACKEND_TIMEOUT_S", "15")
 # winners across tests or with the developer's real cache — tests that
 # exercise persistence point this at a tmp_path explicitly
 os.environ.setdefault("CYCLONUS_AUTOTUNE_CACHE", "0")
+# same discipline for the persistent AOT executable cache
+# (engine/aot_cache.py): unrelated tests must never adopt executables
+# from — or leak them into — the developer's per-user cache; the
+# restart-contract tests point it at a tmp_path explicitly
+os.environ.setdefault("CYCLONUS_AOT_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -13,6 +13,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run_bench(env_extra, timeout=120):
     env = dict(os.environ)
+    # hermetic persistent caches: the stall/watchdog premises assume the
+    # subprocess actually PAYS its compiles — a developer/CI home dir
+    # whose JAX disk cache (or AOT executable cache) is already warm at
+    # these shapes would silently collapse warmup below the stall bound
+    # and flip the expected rc (observed: the cache warmed by one run
+    # broke the next).  Tests that exercise the caches point them at a
+    # tmp path explicitly.
+    env.setdefault("CYCLONUS_JAX_CACHE", "0")
+    env.setdefault("CYCLONUS_AOT_CACHE", "0")
+    env.setdefault("CYCLONUS_AUTOTUNE_CACHE", "0")
     # pin CPU inside the subprocess: the env var alone is overridden by
     # the axon sitecustomize on TPU machines (tests/conftest.py docstring)
     env.update(env_extra)
@@ -225,6 +235,19 @@ class TestBenchGuards:
         assert cold["outcome"] == "ok"
         assert cold["attempts"] >= 1
         assert cold["backend_init_s"] is not None
+        # structured last-error: None on a clean first-attempt attach
+        assert cold["last_error"] is None
+        # AOT executable-cache forensics ride every cold_start block
+        # (here: cache pinned off by the hermetic run_bench env)
+        aot = cold["aot_cache"]
+        for k in ("hits", "misses", "adopted", "compiles"):
+            assert aot[k] == 0
+        assert aot["dir"] is None
+        # detail.chaos rides EVERY line like detail.mesh: on this CPU
+        # run the auto mode skips the leg but the schema still appears
+        chaos_detail = detail["chaos"]
+        assert chaos_detail["ttfv_s"] is None
+        assert "make chaos" in chaos_detail["skipped"]
         assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
         # roofline only reports for the pallas backend
         assert detail["roofline"] is None
@@ -288,6 +311,48 @@ class TestBenchGuards:
         # whether a --trace-dir/BENCH_TRACE_DIR jax-profiler artifact
         # was written this run (here: no capture requested)
         assert detail["trace"] == {"dir": None, "written": False}
+
+    def test_chaos_injection_and_forced_chaos_leg(self, tmp_path):
+        """End to end through bench: an injected backend-init fault
+        (CYCLONUS_CHAOS) retries with the structured last_error
+        retained, and the FORCED chaos leg kills/restarts a real serve
+        subprocess with a bounded time-to-first-verdict recorded in
+        detail.chaos."""
+        proc = run_bench(
+            {
+                "BENCH_PODS": "64",
+                "BENCH_POLICIES": "8",
+                "BENCH_SAMPLE": "2",
+                "BENCH_MESH": "0",
+                "BENCH_PARITY": "0",
+                "BENCH_SERVE": "0",
+                "BENCH_TIERS": "0",
+                "BENCH_COUNTS_BACKEND": "xla",
+                "BENCH_CHAOS": "1",
+                "BENCH_CHAOS_PODS": "12",
+                "BENCH_CHAOS_DELTAS": "2",
+                "CYCLONUS_CHAOS": "backend_init:1",
+                # the serve children must not inherit the armed spec
+                # beyond the one budgeted fault (backend_init is not a
+                # serve point, so inheritance is harmless — pinned here
+                # for clarity) and they may use a warm tmp AOT cache
+                "CYCLONUS_AOT_CACHE": str(tmp_path / "aot"),
+            },
+            timeout=420,
+        )
+        assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-500:]
+        out = last_json_line(proc.stdout)
+        assert out["failure_class"] == "ok"
+        cold = out["detail"]["cold_start"]
+        # one injected failure, recovered on the counted retry, the
+        # structured forensics naming the injected class
+        assert cold["attempts"] == 2
+        assert cold["last_error"]["type"] == "ChaosError"
+        assert "backend_init" in cold["last_error"]["message"]
+        chaos_detail = out["detail"]["chaos"]
+        assert chaos_detail["ok"] is True
+        assert 0 < chaos_detail["ttfv_s"] <= chaos_detail["ttfv_bound_s"]
+        assert chaos_detail["oracle_checked"] >= 16
 
     def test_mega_class_case_records_compression(self):
         """BENCH_MEGA=1 (shrunk for CI) runs the synthetic-cluster

@@ -1124,3 +1124,165 @@ class TestMeshScalingPerChip:
         pb = detail["peer_buffer_bytes"]
         assert pb["ring"] < pb["allgather"]
         assert detail["grid_parity"]["bit_identical"] is True
+
+
+def aot_block(hits=4, misses=0, adopted=4, compiles=0):
+    return {
+        "hits": hits,
+        "misses": misses,
+        "adopted": adopted,
+        "stores": misses,
+        "compiles": compiles,
+        "dir": "/tmp/aot",
+    }
+
+
+class TestAotAndChaosFields:
+    """detail.cold_start.aot_cache + detail.chaos (docs/DESIGN.md "Cold
+    start & chaos"): the ledger parses them, warmup_s graduates to a
+    HARD absolute bound on cache-bearing runs, and the chaos
+    time-to-first-verdict rides warn-only."""
+
+    def _ledger(self, *docs, tmp_path):
+        return load_ledger(write_rounds(tmp_path, list(docs)))
+
+    def _line(self, value=100e9, warmup=5.0, aot=None, chaos=None):
+        line = healthy_line(value=value, warmup=warmup)
+        if aot is not None:
+            line["detail"]["cold_start"]["aot_cache"] = aot
+        if chaos is not None:
+            line["detail"]["chaos"] = chaos
+        return line
+
+    def test_ledger_parses_aot_and_chaos(self, tmp_path):
+        led = self._ledger(
+            wrap(1, self._line(
+                aot=aot_block(hits=5, adopted=5),
+                chaos={"ttfv_s": 3.1, "ttfv_bound_s": 150.0, "ok": True},
+            )),
+            tmp_path=tmp_path,
+        )
+        run = led.runs[0]
+        assert run.aot_hits == 5 and run.aot_adopted == 5
+        assert run.aot_misses == 0 and run.aot_compiles == 0
+        assert run.chaos_ttfv_s == 3.1
+        from cyclonus_tpu.perfobs.schema import PerfRun
+
+        again = PerfRun.from_dict(run.to_dict())
+        assert again.aot_adopted == 5 and again.chaos_ttfv_s == 3.1
+
+    def test_legacy_artifacts_have_no_aot_fields(self, tmp_path):
+        led = self._ledger(wrap(1, self._line()), tmp_path=tmp_path)
+        run = led.runs[0]
+        assert run.aot_adopted is None and run.chaos_ttfv_s is None
+
+    def test_cache_bearing_run_hard_gates_warmup(self, tmp_path):
+        """A run that ADOPTED executables gets the absolute ceiling —
+        even a warmup inside the legacy relative tolerance fails when
+        it exceeds warmup_cached_max_s."""
+        led = self._ledger(
+            wrap(1, self._line(warmup=6.0)),
+            wrap(2, self._line(warmup=6.2)),
+            # warmup 7.0 passes the relative bound (6.0 * 1.5 + 2 = 11)
+            # but a cache-bearing run must beat the 5s hard ceiling
+            wrap(3, self._line(value=110e9, warmup=7.0,
+                               aot=aot_block(hits=6, adopted=6))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "engine_regression", result.report()
+        assert "warmup_s[aot-cached]" in result.report()
+
+    def test_cache_bearing_run_within_hard_bound_passes(self, tmp_path):
+        led = self._ledger(
+            wrap(1, self._line(warmup=6.0)),
+            wrap(2, self._line(warmup=6.2)),
+            wrap(3, self._line(value=110e9, warmup=2.5,
+                               aot=aot_block(hits=6, adopted=6))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+    def test_half_warm_cache_keeps_relative_posture(self, tmp_path):
+        """adopted > 0 but compiles > 0 = a partially warm cache that
+        legitimately paid some compiles — the hard ceiling must not
+        arm (only fully-warm restarts have no storm left)."""
+        led = self._ledger(
+            wrap(1, self._line(warmup=6.0)),
+            wrap(2, self._line(warmup=6.2)),
+            wrap(3, self._line(value=110e9, warmup=7.0,
+                               aot=aot_block(hits=3, misses=3,
+                                             adopted=3, compiles=3))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+    def test_uncached_run_keeps_relative_posture(self, tmp_path):
+        """No adoption (cold cache: adopted == 0) -> the legacy
+        relative bound alone applies; 7.0s still passes."""
+        led = self._ledger(
+            wrap(1, self._line(warmup=6.0)),
+            wrap(2, self._line(warmup=6.2)),
+            wrap(3, self._line(value=110e9, warmup=7.0,
+                               aot=aot_block(hits=0, misses=6,
+                                             adopted=0, compiles=6))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+    def test_warmup_cached_max_is_tunable(self, tmp_path):
+        led = self._ledger(
+            wrap(1, self._line(warmup=6.0)),
+            wrap(2, self._line(value=110e9, warmup=7.0,
+                               aot=aot_block(hits=6, adopted=6))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led, warmup_cached_max_s=8.0)
+        assert result.status == "pass", result.report()
+
+    def test_chaos_ttfv_degradation_warns_never_fails(self, tmp_path):
+        led = self._ledger(
+            wrap(1, self._line(chaos={"ttfv_s": 3.0})),
+            wrap(2, self._line(chaos={"ttfv_s": 3.5})),
+            wrap(3, self._line(value=120e9, chaos={"ttfv_s": 30.0})),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert "time-to-first-verdict degraded" in result.report()
+
+    def test_chaos_phase_not_generically_gated(self, tmp_path):
+        base = self._line()
+        slow = self._line(value=120e9)
+        base["detail"]["phase_history_s"].append(["chaos", 1.0])
+        slow["detail"]["phase_history_s"].append(["chaos", 90.0])
+        led = self._ledger(
+            wrap(1, base), wrap(2, self._line()), wrap(3, slow),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+    def test_report_surfaces_aot_and_ttfv(self, tmp_path):
+        from cyclonus_tpu.perfobs import report as report_mod
+
+        led = self._ledger(
+            wrap(1, self._line(aot=aot_block(hits=5, adopted=5),
+                               chaos={"ttfv_s": 3.1})),
+            tmp_path=tmp_path,
+        )
+        md = report_mod.render_markdown(led)
+        assert "(aot)" in md
+        assert "time-to-first-verdict" in md
+        report_mod.publish(led)
+        from cyclonus_tpu.perfobs.report import (
+            PERF_AOT_ADOPTED,
+            PERF_CHAOS_TTFV,
+        )
+
+        run_id = led.runs[0].run_id
+        assert PERF_AOT_ADOPTED.value(run=run_id) == 5.0
+        assert PERF_CHAOS_TTFV.value(run=run_id) == 3.1

@@ -260,6 +260,82 @@ def _scrape(url):
         return resp.read().decode()
 
 
+def _scrape_status(url):
+    """(status, body) — 503s must be readable, not raised."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestReadiness:
+    """Liveness vs readiness split (docs/DESIGN.md "Cold start &
+    chaos"): /healthz answers liveness unconditionally; /readyz
+    consults the optional registered callback so warming != ready."""
+
+    def _server(self):
+        from cyclonus_tpu.telemetry.server import start_metrics_server
+
+        return start_metrics_server(0)
+
+    def test_healthz_stays_liveness_and_readyz_defaults_ready(self):
+        from cyclonus_tpu.telemetry.server import (
+            register_readiness,
+            stop_metrics_server,
+        )
+
+        register_readiness(None)
+        srv = self._server()
+        try:
+            assert _scrape(srv.url + "/healthz").strip() == "ok"
+            status, body = _scrape_status(srv.url + "/readyz")
+            assert status == 200 and body.startswith("ready")
+        finally:
+            stop_metrics_server()
+
+    def test_readyz_follows_callback_healthz_does_not(self):
+        """The regression the satellite fix exists for: one mounted
+        server, one readiness answer per STATE — a warming callback
+        turns /readyz 503 while /healthz keeps answering 200."""
+        from cyclonus_tpu.telemetry.server import (
+            register_readiness,
+            stop_metrics_server,
+        )
+
+        state = {"ready": False}
+        register_readiness(lambda: (state["ready"], "warming test"))
+        srv = self._server()
+        try:
+            status, body = _scrape_status(srv.url + "/readyz")
+            assert status == 503 and "warming" in body
+            assert _scrape(srv.url + "/healthz").strip() == "ok"
+            state["ready"] = True
+            status, body = _scrape_status(srv.url + "/readyz")
+            assert status == 200 and "warming test" in body
+        finally:
+            register_readiness(None)
+            stop_metrics_server()
+
+    def test_broken_callback_reads_not_ready(self):
+        from cyclonus_tpu.telemetry.server import (
+            register_readiness,
+            stop_metrics_server,
+        )
+
+        def boom():
+            raise RuntimeError("probe exploded")
+
+        register_readiness(boom)
+        srv = self._server()
+        try:
+            status, body = _scrape_status(srv.url + "/readyz")
+            assert status == 503 and "probe exploded" in body
+        finally:
+            register_readiness(None)
+            stop_metrics_server()
+
+
 class TestMetricsEndpoint:
     def test_probe_run_with_metrics_port_exposes_engine_metrics(self):
         """Acceptance: a probe run with --metrics-port serves the engine

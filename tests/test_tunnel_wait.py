@@ -155,6 +155,89 @@ class TestProbe:
         assert len(sleeps) == 2  # no sleep after the final attempt
 
 
+class TestStructuredLastError:
+    """Satellite: the probe/bench retry loops report a structured
+    last-error (exception class + truncated message) into the JSON
+    line, so perfobs forensics can split SIGILL-class host faults from
+    tunnel death without scraping the stderr tail."""
+
+    def test_probe_dead_records_exit_and_stderr(self, monkeypatch):
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc("", 3, stderr="Illegal instruction\n"),
+        )
+        state = {}
+        assert tunnel_wait.probe_tunnel(0.1, state=state) is False
+        assert state["attempts"] == 1
+        assert state["last_error"]["type"] == "ProbeExit3"
+        assert "Illegal instruction" in state["last_error"]["message"]
+
+    def test_probe_timeout_records_exception_class(self, monkeypatch):
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="p", timeout=1)
+
+        monkeypatch.setattr(tunnel_wait.subprocess, "run", fake_run)
+        state = {}
+        assert tunnel_wait.probe_tunnel(0.1, state=state) is False
+        assert state["last_error"]["type"] == "TimeoutExpired"
+
+    def test_probe_alive_clears_last_error(self, monkeypatch):
+        rcs = iter([3, 0])
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc("", next(rcs)),
+        )
+        monkeypatch.setattr(tunnel_wait.time, "sleep", lambda s: None)
+        state = {}
+        assert tunnel_wait.probe_tunnel(0.1, attempts=2, state=state)
+        assert state["attempts"] == 2
+        assert state["last_error"] is None
+
+    def test_run_bench_no_json_carries_structured_error(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc("no json here", 1, stderr="SIGILL\n"),
+        )
+        out = str(tmp_path / "o.json")
+        result = tunnel_wait.run_bench(out, bound_s=5)
+        assert result["last_error"]["type"] == "BenchExit1"
+        assert "SIGILL" in result["last_error"]["message"]
+
+    def test_run_bench_timeout_carries_structured_error(
+        self, monkeypatch, tmp_path
+    ):
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="bench", timeout=5)
+
+        monkeypatch.setattr(tunnel_wait.subprocess, "run", fake_run)
+        result = tunnel_wait.run_bench(str(tmp_path / "o.json"), bound_s=5)
+        assert result["last_error"]["type"] == "TimeoutExpired"
+
+    def test_run_bench_attaches_probe_forensics(self, monkeypatch, tmp_path):
+        line = json.dumps({"value": 1, "unit": "cells/sec",
+                           "failure_class": "ok"})
+        monkeypatch.setattr(
+            tunnel_wait.subprocess,
+            "run",
+            lambda *a, **kw: _Proc(line + "\n", 0),
+        )
+        probe_state = {
+            "attempts": 3,
+            "last_error": {"type": "ProbeExit3", "message": "no tpu"},
+        }
+        result = tunnel_wait.run_bench(
+            str(tmp_path / "o.json"), bound_s=5,
+            probe_forensics=probe_state,
+        )
+        assert result["probe"]["attempts"] == 3
+        assert result["probe"]["last_error"]["type"] == "ProbeExit3"
+
+
 class TestFailureClass:
     def test_success_result_carries_ok(self, monkeypatch, tmp_path):
         line = json.dumps(

@@ -68,15 +68,27 @@ def probe_tunnel(
     attempts: int = 1,
     backoff_s: float = 2.0,
     rng: random.Random = None,
+    state: dict = None,
 ) -> bool:
     """True iff a fresh interpreter can enumerate a TPU device within
     bound_s.  Timeout/crash/non-TPU all count as dead.  With attempts
     > 1, dead probes retry after a full-jitter exponential backoff
     (base * 2^(n-1) * U[0.5, 1.5) — desynced from other clients racing
     for the same chip); every attempt lands in the
-    cyclonus_tpu_tunnel_probe_attempts_total counter by outcome."""
+    cyclonus_tpu_tunnel_probe_attempts_total counter by outcome.
+
+    `state` (optional dict) is filled with STRUCTURED forensics —
+    {"attempts": n, "last_error": {"type", "message"} | None} — so the
+    round artifact can say WHAT killed the probe (a SIGILL-class host
+    fault prints a signature the attempt count alone can't carry),
+    distinguishing it from plain tunnel death without scraping the
+    stderr tail."""
     rng = rng or random.Random()
+    if state is None:
+        state = {}
+    state.setdefault("last_error", None)
     for attempt in range(1, max(1, attempts) + 1):
+        state["attempts"] = attempt
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", PROBE_CODE],
@@ -85,17 +97,32 @@ def probe_tunnel(
                 cwd=REPO,
             )
             outcome = "alive" if proc.returncode == 0 else "dead"
-        except (subprocess.TimeoutExpired, OSError):
+            if outcome == "dead":
+                stderr = (proc.stderr or b"")
+                if isinstance(stderr, bytes):
+                    stderr = stderr.decode(errors="replace")
+                state["last_error"] = {
+                    "type": f"ProbeExit{proc.returncode}",
+                    "message": stderr.strip()[-200:],
+                }
+        except (subprocess.TimeoutExpired, OSError) as e:
             outcome = "timeout"
+            state["last_error"] = {
+                "type": type(e).__name__,
+                "message": str(e)[:200],
+            }
         _count_probe(outcome)
         if outcome == "alive":
+            state["last_error"] = None
             return True
         if attempt <= max(1, attempts) - 1:
             time.sleep(full_jitter_pause(backoff_s, attempt, rng))
     return False
 
 
-def run_bench(out_path: str, bound_s: float = None) -> dict:
+def run_bench(
+    out_path: str, bound_s: float = None, probe_forensics: dict = None
+) -> dict:
     """One full bench attempt; returns the parsed JSON line (or an error
     dict).  The bench's own watchdogs are the real bounds — they print
     the diagnostic JSON with phase history that this tool exists to
@@ -126,10 +153,23 @@ def run_bench(out_path: str, bound_s: float = None) -> dict:
         # its signature here, not in any JSON
         tail = (proc.stdout or "")[-2000:] + (proc.stderr or "")[-2000:]
         result = last_json_line(proc.stdout) or {
-            "error": f"bench produced no JSON (rc={rc})"
+            "error": f"bench produced no JSON (rc={rc})",
+            # structured last-error: the no-JSON signature (r03's
+            # backend warning, a SIGILL banner) lives in the tail —
+            # class + truncated message, machine-readable
+            "last_error": {
+                "type": f"BenchExit{rc}",
+                "message": tail.strip()[-200:],
+            },
         }
     except subprocess.TimeoutExpired as e:
-        result = {"error": f"bench exceeded the {bound_s:g}s subprocess bound"}
+        result = {
+            "error": f"bench exceeded the {bound_s:g}s subprocess bound",
+            "last_error": {
+                "type": type(e).__name__,
+                "message": str(e)[:200],
+            },
+        }
         for out in (e.stdout, e.stderr):  # same evidence as the normal path
             if not out:
                 continue
@@ -139,7 +179,18 @@ def run_bench(out_path: str, bound_s: float = None) -> dict:
     except json.JSONDecodeError as e:
         # a killed/crashed bench can leave a TRUNCATED final JSON line on
         # stdout; that's an error result, not a watchdog-loop killer
-        result = {"error": f"bench stdout ended in unparseable JSON: {e}"}
+        result = {
+            "error": f"bench stdout ended in unparseable JSON: {e}",
+            "last_error": {
+                "type": type(e).__name__,
+                "message": str(e)[:200],
+            },
+        }
+    if probe_forensics:
+        # the round's probe history rides the same JSON line: attempt
+        # count + the structured last probe error (None when the final
+        # probe answered alive)
+        result["probe"] = dict(probe_forensics)
     result["bench_rc"] = rc
     result["at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     if "failure_class" not in result:
@@ -198,15 +249,17 @@ def main() -> int:
     last_success = 0.0
     benched_ok = None  # tri-state for --once: None = bench never ran
     while True:
+        probe_state: dict = {}
         alive = probe_tunnel(
             args.probe_bound,
             attempts=args.probe_retries,
             backoff_s=args.probe_backoff,
+            state=probe_state,
         )
         now = time.strftime("%H:%M:%S")
         if alive and (time.time() - last_success) >= args.rebench_every:
             print(f"[{now}] tunnel ALIVE -> running bench", flush=True)
-            result = run_bench(args.out)
+            result = run_bench(args.out, probe_forensics=probe_state)
             benched_ok = "error" not in result and result.get("value", 0) > 0
             print(
                 f"[{time.strftime('%H:%M:%S')}] bench "
@@ -217,7 +270,13 @@ def main() -> int:
                 last_success = time.time()
         else:
             state = "alive (artifact fresh)" if alive else "DEAD"
-            print(f"[{now}] tunnel {state}", flush=True)
+            err = probe_state.get("last_error")
+            suffix = (
+                f" (last error {err['type']}: {err['message'][:80]})"
+                if err
+                else ""
+            )
+            print(f"[{now}] tunnel {state}{suffix}", flush=True)
         if args.once:
             # rc reflects the OUTCOME, not just the probe: a caller
             # gating on --once must not mistake "tunnel answered but
