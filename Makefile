@@ -17,20 +17,42 @@ test:
 # shape/dtype/sentinel/tile-alignment contracts of the encoding->kernel
 # pipeline; see docs/DESIGN.md "Tensor contracts") over the engine, the
 # analysis layer, and the worker wire model.
-lint: shapelint
+# The fourth leg, the cache-coherence lint (tools/cachelint.py —
+# cache-key completeness of every compiled/persisted program,
+# derived-cache invalidation, env-on-cached-path, persisted write
+# discipline, never-raise degradation contracts; docs/DESIGN.md "Cache
+# discipline"), runs over the cache-bearing packages.
+# tests/test_cachelint.py pins the four legs under a combined
+# one-minute wall-clock budget so the gate stays cheap enough to run.
+lint: shapelint cachelint
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
 	  cyclonus_tpu/perfobs cyclonus_tpu/serve cyclonus_tpu/tiers \
-	  cyclonus_tpu/chaos
+	  cyclonus_tpu/chaos cyclonus_tpu/linter cyclonus_tpu/recipes
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
 	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve \
-	  cyclonus_tpu/tiers cyclonus_tpu/chaos
+	  cyclonus_tpu/tiers cyclonus_tpu/chaos cyclonus_tpu/linter \
+	  cyclonus_tpu/recipes
+
+cachelint:
+	python tools/cachelint.py cyclonus_tpu/engine cyclonus_tpu/serve \
+	  cyclonus_tpu/perfobs cyclonus_tpu/chaos
+
+# the key-mutation harness (tests/keyharness.py; docs/DESIGN.md "Cache
+# discipline"): for every registered cache family, perturb each key
+# component one at a time and assert a miss/retrace, then revert and
+# assert a hit — including the subprocess restart leg (a warm AOT
+# cache adopts with ZERO compiles; a mutated dtype-plan component
+# misses every entry with bit-identical verdicts).  The quick slice
+# runs in tier-1 via tests/test_cachelint.py; this is the full sweep.
+keyharness:
+	JAX_PLATFORMS=cpu python -m tests.keyharness --full --verbose
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -132,4 +154,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint shapelint perf-gate parity-compressed serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint shapelint cachelint keyharness perf-gate parity-compressed serve-smoke multichip-smoke cyclonus docker

@@ -216,6 +216,21 @@ def _cold_start_detail(
         # _aot_snapshot); failure paths take the counters as they stand
         # at death.
         "aot_cache": aot if aot is not None else _aot_snapshot(),
+        # cache-key registry census (utils/cachekeys.py): how many
+        # cache families registered their key components this process.
+        # 0 outside the key-mutation harness env — the registry strips
+        # to a no-op (tests/test_bench_guard.py asserts the
+        # cyclonus_tpu_cachekey_* instruments are absent too).
+        "key_audit": _key_audit(),
+    }
+
+
+def _key_audit() -> dict:
+    from cyclonus_tpu.utils import cachekeys
+
+    return {
+        "active": cachekeys.ACTIVE,
+        "registered": cachekeys.registered_count(),
     }
 
 

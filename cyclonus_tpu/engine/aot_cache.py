@@ -47,6 +47,8 @@ import pickle
 import tempfile
 from typing import Any, Dict, Optional, Tuple
 
+from ..utils import cachekeys
+
 log = logging.getLogger(__name__)
 
 #: bump when the entry layout changes: stale versions are ignored
@@ -56,7 +58,7 @@ CACHE_VERSION = 1
 _DEFAULT_DIR = os.path.join("~", ".cache", "cyclonus_tpu", "aot")
 
 
-def cache_dir() -> Optional[str]:
+def cache_dir() -> Optional[str]:  # never-raises
     """Resolved cache directory, or None when persistence is disabled."""
     raw = os.environ.get("CYCLONUS_AOT_CACHE")
     if raw is None:
@@ -68,15 +70,27 @@ def cache_dir() -> Optional[str]:
 
 
 def platform_stamp() -> str:
-    """The (jax version, backend, device kind, device count) stamp an
-    entry must match to load: a serialized executable is a binary for
-    one runtime on one device topology — skew means recompile, never a
-    load attempt that the runtime rejects (or worse, misruns)."""
+    """The (jax + jaxlib version, backend, device kind, device count)
+    stamp an entry must match to load: a serialized executable is a
+    binary for one runtime on one device topology — skew means
+    recompile, never a load attempt that the runtime rejects (or worse,
+    misruns).  jaxlib rides the stamp SEPARATELY from jax: the payload
+    bytes are jaxlib's, and the two versions can be pinned
+    independently — a jaxlib-only upgrade used to slip past the key
+    (found by the tools/cachelint.py key-surface audit; pinned by
+    tests/test_aot_cache.py)."""
     import jax
 
+    try:
+        import jaxlib
+
+        jaxlib_v = getattr(jaxlib, "__version__", "?")
+    except Exception:  # no separate jaxlib dist: jax's version rules
+        jaxlib_v = "?"
     devs = jax.devices()
     return (
-        f"jax={jax.__version__};backend={jax.default_backend()};"
+        f"jax={jax.__version__};jaxlib={jaxlib_v};"
+        f"backend={jax.default_backend()};"
         f"kind={devs[0].device_kind};n={len(devs)}"
     )
 
@@ -106,12 +120,12 @@ def make_key(
     )
 
 
-def _entry_path(base: str, key: str) -> str:
+def _entry_path(base: str, key: str) -> str:  # never-raises
     d = hashlib.sha256(key.encode("utf-8")).hexdigest()[:32]
     return os.path.join(base, f"{d}.aotx")
 
 
-def digest(obj) -> str:
+def digest(obj) -> str:  # never-raises
     """Stable short digest of `repr(obj)` — THE helper for folding
     program identity the arg shapes can't see (unpack leaf metas,
     partition-spec structures) into a cache key's plan.  One
@@ -121,7 +135,7 @@ def digest(obj) -> str:
     return hashlib.sha256(repr(obj).encode("utf-8")).hexdigest()[:16]
 
 
-def load(key: str):
+def load(key: str):  # never-raises
     """The deserialized, loaded executable for `key`, or None (disabled
     / missing / corrupt / version-skewed / key-collided / any
     deserialization failure).  Never raises."""
@@ -163,7 +177,7 @@ def load(key: str):
         return None
 
 
-def store(key: str, compiled) -> bool:
+def store(key: str, compiled) -> bool:  # never-raises
     """Serialize `compiled` under `key` (atomic tmp + os.replace).
     Returns True when written; any failure — an executable kind the
     backend cannot serialize (pallas custom calls on some runtimes),
@@ -294,6 +308,20 @@ class AotProgram:
         self._plan = plan
         self._schedule = schedule
         self._static_argnames = tuple(static_argnames)
+        if cachekeys.ACTIVE:
+            # the key-mutation harness (tests/keyharness.py) proves
+            # each component miss-on-mutate; the fingerprint is the
+            # persisted key with the per-call signature left symbolic
+            cachekeys.register(
+                f"aot:{name}",
+                kind="persisted",
+                components=cachekeys.program(
+                    "name", "signature", "platform", "schedule", "plan"
+                ),
+                fingerprint=make_key(
+                    name, "<signature>", schedule=schedule, plan=plan
+                ),
+            )
         # (call_key, statics) -> compiled | None(=fallback); keyed by
         # the hashable tuple so steady-state dispatches never build a
         # signature string

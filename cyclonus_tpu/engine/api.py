@@ -877,28 +877,34 @@ class TpuPolicyEngine:
         # wall-clock of the last tiered grid evaluation's dispatch
         # (detail.tiers.resolve_s; None until a tiered eval ran)
         self._tier_resolve_s = None
-        self._device_tensors = None  # lazily device_put once
-        self._packed_buf = None  # single-buffer device copy (all paths)
-        self._unpack = None
+        # The trailing `# derived-from:` declarations below are the
+        # cache-coherence contract tools/cachelint.py CC002 enforces:
+        # a VALUE token means invalidate_after_patch must reset the
+        # attribute after an in-place buffer patch; `shapes` marks a
+        # compiled-program cache (shape-keyed, survives value patches);
+        # `patched` marks state the serve patch path maintains itself.
+        self._device_tensors = None  # derived-from: buffer (unpacked views)
+        self._packed_buf = None  # derived-from: patched (scatter writes back)
+        self._unpack = None  # derived-from: patched (layout fixed at build)
         # jit wrappers over the unpack closures, cached so the serve
         # layer's patch/invalidate cycle re-unpacks through the SAME
         # compiled program instead of retracing per patch
-        self._unpack_jit = None
-        self._class_unpack_jit = None
+        self._unpack_jit = None  # derived-from: shapes
+        self._class_unpack_jit = None  # derived-from: shapes
         # compressed-path device state (all lazy; None when no class
         # state): packed class-representative buffer + unpacked pytree,
         # the pod->class gather map, and the fused grid+gather program
-        self._class_packed_buf = None
-        self._class_unpack = None
-        self._class_device_tensors = None
-        self._class_of_dev = None
-        self._class_grid_jit = None
-        self._pod_perm_dev = None  # ns-order pod permutation (counts path)
-        self._pod_perm_host = None
-        self._slab_plan_state = "unset"  # -> None | {direction: t0 dev array}
+        self._class_packed_buf = None  # derived-from: patched
+        self._class_unpack = None  # derived-from: patched
+        self._class_device_tensors = None  # derived-from: buffer
+        self._class_of_dev = None  # derived-from: classes
+        self._class_grid_jit = None  # derived-from: shapes
+        self._pod_perm_dev = None  # derived-from: pod-rows (ns-order perm)
+        self._pod_perm_host = None  # derived-from: pod-rows
+        self._slab_plan_state = "unset"  # derived-from: buffer (window proof)
         # None = not yet tuned (auto mode times both at the first
         # steady-state call); True/False = slab kernel chosen/rejected
-        self._slab_choice = None
+        self._slab_choice = None  # derived-from: buffer (re-timed)
         self._slab_autotune = None  # {"default_s", "slab_s"} once timed
         # the bit-packed dtype plan (docs/DESIGN.md "Bit-packed
         # kernel"): resolved ONCE per engine from CYCLONUS_PACK — the
@@ -908,14 +914,14 @@ class TpuPolicyEngine:
         # persistent AOT executable adapters (engine/aot_cache.py):
         # built lazily per program family; with CYCLONUS_AOT_CACHE off
         # they pass straight through to the plain jits
-        self._grid_aot = None
-        self._pairs_aot = None
+        self._grid_aot = None  # derived-from: shapes
+        self._pairs_aot = None  # derived-from: shapes
         # the tuned counts configuration: None until the autotune (or a
         # persisted-cache adoption) picks one; then {"kernel":
         # "default"|"slab"|"packed", optional "bs"/"bd"}.  Shares
         # _slab_lock with _slab_choice so the pair can never be read
         # half-updated against the autotune's abandoned thread.
-        self._kernel_choice = None
+        self._kernel_choice = None  # derived-from: buffer (re-tuned)
         # autotune forensics for bench detail.pack: {"source":
         # search|cache|single, "search_s", "candidates": [...],
         # "noise_floor"} once the first steady-state call resolves it
@@ -933,21 +939,21 @@ class TpuPolicyEngine:
         # rejection writes and the ops-cache fill can race an abandoned
         # candidate thread still inside _slab_ops_for
         self._slab_lock = guards.lock()
-        self._counts_packed_jit = None
+        self._counts_packed_jit = None  # derived-from: shapes
         # steady-state counts: cache the device-resident precompute per
         # port-case set so repeat evaluations run only the pallas kernel
-        self._pre_jit = None
-        self._counts_from_pre_jit = None
-        self._counts_from_pre_packed_jit = None  # tuned-tile packed twin
-        self._pre_cache = None  # (cases key, device pre pytree)
+        self._pre_jit = None  # derived-from: shapes
+        self._counts_from_pre_jit = None  # derived-from: shapes
+        self._counts_from_pre_packed_jit = None  # derived-from: shapes
+        self._pre_cache = None  # derived-from: buffer (cases key + pre pytree)
         # gathered slab operands, cached next to the pre: building them
         # per dispatch cost more than the slab's depth cut saved (r5)
-        self._slab_ops_jit = None
-        self._counts_from_slab_ops_jit = None
-        self._slab_ops_cache = None  # (cases key, {a_e,b_e,b_i,a_i})
-        self._pre_cache_misses = 0
-        self._pre_cache_declined = None  # key whose pre exceeded the cap
-        self._last_counts_key = None
+        self._slab_ops_jit = None  # derived-from: shapes
+        self._counts_from_slab_ops_jit = None  # derived-from: shapes
+        self._slab_ops_cache = None  # derived-from: buffer (gathered ops)
+        self._pre_cache_misses = 0  # derived-from: buffer
+        self._pre_cache_declined = None  # derived-from: buffer (declined key)
+        self._last_counts_key = None  # derived-from: buffer
         self._has_ip_peers = (
             bool(np.any(self.encoding.ingress.peer_kind == PEER_IP))
             or bool(np.any(self.encoding.egress.peer_kind == PEER_IP))

@@ -33,6 +33,8 @@ import os
 import tempfile
 from typing import Any, Dict, Optional
 
+from ..utils import cachekeys
+
 log = logging.getLogger(__name__)
 
 #: bump when the entry layout or the meaning of a winner changes: stale
@@ -49,7 +51,7 @@ _DEFAULT_PATH = os.path.join(
 )
 
 
-def cache_path() -> Optional[str]:
+def cache_path() -> Optional[str]:  # never-raises
     """Resolved cache file path, or None when persistence is disabled."""
     raw = os.environ.get("CYCLONUS_AUTOTUNE_CACHE")
     if raw is None:
@@ -69,20 +71,37 @@ def make_key(
     (backend + device kind + count), and the DTYPE PLAN (packed32 /
     int8 / bf16).  Two processes with equal keys run byte-identical
     candidate programs, which is what makes the winner transferable."""
-    return json.dumps(
+    key = json.dumps(
         {"shape": shape_bucket, "mesh": mesh, "dtype": dtype_plan},
         sort_keys=True,
         separators=(",", ":"),
     )
+    if cachekeys.ACTIVE:
+        cachekeys.register(
+            "autotune",
+            kind="persisted",
+            components=cachekeys.program(
+                "shape_bucket", "mesh", "dtype_plan"
+            ),
+            fingerprint=key,
+        )
+    return key
 
 
-def _read_all(path: str) -> Dict[str, Any]:
+def _read_all(path: str) -> Dict[str, Any]:  # never-raises
     """The whole cache file as a dict — {} on ANY problem (missing,
-    truncated JSON, wrong top-level type, version skew)."""
+    truncated JSON, wrong top-level type, version skew).  The handler
+    is deliberately BROAD: the old (OSError, ValueError) pair let a
+    pathological entry escape the documented any-problem contract
+    (e.g. RecursionError from absurd nesting) — found by
+    tools/cachelint.py CC005."""
     try:
         with open(path, "r", encoding="utf-8") as f:
             data = json.load(f)
-    except (OSError, ValueError):
+    except FileNotFoundError:
+        return {}
+    except Exception as e:
+        log.debug("autotune cache unreadable (%s): %s", path, e)
         return {}
     if not isinstance(data, dict) or data.get("v") != CACHE_VERSION:
         return {}
@@ -90,7 +109,7 @@ def _read_all(path: str) -> Dict[str, Any]:
     return entries if isinstance(entries, dict) else {}
 
 
-def load_winner(key: str) -> Optional[Dict[str, Any]]:
+def load_winner(key: str) -> Optional[Dict[str, Any]]:  # never-raises
     """The persisted winner for `key`, or None (disabled / missing /
     corrupt / stale / malformed entry).  Returns the winner dict
     ({"kernel": ..., optional "bs"/"bd", ...}); timings ride along under
@@ -105,18 +124,24 @@ def load_winner(key: str) -> Optional[Dict[str, Any]]:
     if not isinstance(winner, dict) or winner.get("kernel") not in KNOWN_KERNELS:
         return None
     for dim in ("bs", "bd"):
-        if dim in winner and not isinstance(winner[dim], int):
+        v = winner.get(dim)
+        if v is not None and not isinstance(v, int):
             return None
     return winner
 
 
-def store_winner(
+def store_winner(  # never-raises
     key: str, winner: Dict[str, Any], timings: Optional[Dict[str, Any]] = None
 ) -> bool:
     """Persist `winner` under `key` (read-merge-atomic-replace).
     Returns True when written; failures log and return False — a broken
     cache disk must never take down the engine that just finished a
-    perfectly good search."""
+    perfectly good search.  The handler is BROAD on purpose: the old
+    `except OSError` let json.dump's TypeError on a non-serializable
+    winner/timing value escape into the evaluation that just finished a
+    perfectly good search, violating this very docstring (REAL bug
+    surfaced by tools/cachelint.py CC005; regression-pinned in
+    tests/test_cachelint.py)."""
     path = cache_path()
     if path is None:
         return False
@@ -138,6 +163,6 @@ def store_winner(
                 pass
             raise
         return True
-    except OSError as e:
+    except Exception as e:
         log.warning("autotune cache write failed (%s): %s", path, e)
         return False

@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..telemetry import instruments as ti
+from ..utils import cachekeys
 
 try:  # JAX >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
@@ -425,7 +426,7 @@ def peer_buffer_bytes(
 #: family) — re-jitting per eval cost a full retrace every call, and a
 #: same-bucket cluster resize must hit this cache (zero-recompile
 #: contract, pinned by tests/test_engine_sharded.py)
-_SHARDED_PROGRAMS: Dict = {}
+_SHARDED_PROGRAMS: Dict = {}  # cache-key: mesh, schedule, shard, pack, specs
 _SHARDED_PROGRAMS_MAX = 64
 
 
@@ -480,6 +481,14 @@ def _sharded_program(
                 f"mesh={','.join(mesh.axis_names)}x{n_dev};{spec_digest}"
             ),
         )
+        if cachekeys.ACTIVE:
+            cachekeys.register(
+                "sharded.programs",
+                kind="program",
+                components=cachekeys.program(
+                    "mesh", "schedule", "shard", "pack", "specs"
+                ),
+            )
         if len(_SHARDED_PROGRAMS) >= _SHARDED_PROGRAMS_MAX:
             _SHARDED_PROGRAMS.clear()  # crude bound; programs re-jit
         _SHARDED_PROGRAMS[key] = fn

@@ -51,6 +51,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..telemetry import instruments as ti
+from ..utils import cachekeys
 from ..utils.tracing import phase
 from .encoding import TIER_KEY_NONE, pack_enabled
 from .kernel import (
@@ -925,7 +926,7 @@ _RING_SPECS = {
     "valid": P("x"),  # shape: (N,) bool
 }
 
-_RING_PIPELINES: Dict = {}
+_RING_PIPELINES: Dict = {}  # cache-key: mesh, shard, block, n_pods, tiered, pack, specs
 _RING_PIPELINES_MAX = 32
 
 
@@ -1022,6 +1023,14 @@ def ring_counts_pipeline(tensors: Dict, n_pods: int, block: int, mesh):
         donate_argnums=(1,),
     )
     out = (seed_fn, step_fn, {"shard": shard, "tiles": tiles_per_shard})
+    if cachekeys.ACTIVE:
+        cachekeys.register(
+            "ring.pipelines",
+            kind="program",
+            components=cachekeys.program(
+                "mesh", "shard", "block", "n_pods", "tiered", "pack", "specs"
+            ),
+        )
     if len(_RING_PIPELINES) >= _RING_PIPELINES_MAX:
         _RING_PIPELINES.clear()
     _RING_PIPELINES[key] = out

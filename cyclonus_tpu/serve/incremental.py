@@ -88,16 +88,19 @@ class Ineligible(Exception):
     must fall back to a full rebuild from authoritative state."""
 
 
-def serve_headroom() -> int:
+def serve_headroom() -> int:  # never-raises
     """CYCLONUS_SERVE_HEADROOM: extra rule-slab bucket steps the serve
     path pre-reserves at engine build (default 1 — one bucket of
     headroom absorbs most bucket-crossing policy churn, keeping it on
-    the incremental path; 0 restores exact-fit buckets)."""
+    the incremental path; 0 restores exact-fit buckets).  A malformed
+    value degrades to the default with a debug log (the cachelint CC005
+    evidence discipline), never an error at engine build."""
     import os
 
     try:
         return max(0, int(os.environ.get("CYCLONUS_SERVE_HEADROOM", "1")))
-    except ValueError:
+    except Exception as e:
+        logger.debug("malformed CYCLONUS_SERVE_HEADROOM: %s", e)
         return 1
 
 
@@ -109,7 +112,7 @@ def pow2_pad(n: int) -> int:
     return 1 << max(3, int(n - 1).bit_length())
 
 
-def patch_byte_budget() -> int:
+def patch_byte_budget() -> int:  # never-raises
     """CYCLONUS_SLAB_MAX_BYTES as the staged-patch ceiling (default
     6 GiB) — the one parse every patch path (pod/ns rows in service.py,
     rule slabs in patch_policy) shares, so a malformed value degrades
@@ -118,7 +121,8 @@ def patch_byte_budget() -> int:
 
     try:
         return int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
-    except ValueError:
+    except Exception as e:
+        logger.debug("malformed CYCLONUS_SLAB_MAX_BYTES: %s", e)
         return 6 * 2**30
 
 
@@ -135,7 +139,7 @@ def _scatter_words(buf, idx: np.ndarray, vals: np.ndarray):
     return _SCATTER_JIT(buf, idx, vals)
 
 
-_SCATTER_JIT = None
+_SCATTER_JIT = None  # cache-key: shapes (one executable per (buffer, idx) shape)
 
 
 class _PatchSet:

@@ -84,6 +84,12 @@ class FuzzReport:
     mesh_cells_checked: int = 0  # cells re-checked via the overlapped mesh
     pair_checks: int = 0
     tiered_seeds: int = 0
+    #: reference-linter leg (cyclonus_tpu/linter/checks.py, the ported
+    #: pkg/linter): every seed's generated NetworkPolicy set runs
+    #: linter.lint non-crashing; warning totals ride the report — the
+    #: reference parity pass finally exercised at generator scale
+    lint_warnings: int = 0
+    lint_warnings_by_check: Dict[str, int] = field(default_factory=dict)
 
     def to_dict(self) -> Dict:
         return {
@@ -92,6 +98,8 @@ class FuzzReport:
             "mesh_cells_checked": self.mesh_cells_checked,
             "pair_checks": self.pair_checks,
             "tiered_seeds": self.tiered_seeds,
+            "lint_warnings": self.lint_warnings,
+            "lint_warnings_by_check": dict(self.lint_warnings_by_check),
         }
 
 
@@ -430,6 +438,14 @@ def run_seed(
     the virtual multi-device mesh) and pins it bit-identical to the
     same oracle table — the `make fuzz` mesh leg."""
     fc = build_fuzz_case(seed)
+    # reference-linter leg: the ported pkg/linter checks
+    # (cyclonus_tpu/linter/checks.py) must process every generated
+    # NetworkPolicy set WITHOUT crashing — adversarial selector/port/
+    # CIDR shapes included.  A crash fails the seed gate with the seed
+    # named; the warning census rides the report.
+    from ..linter.checks import lint as policy_lint
+
+    lint_warnings = policy_lint(fc.netpols)
     policy = build_network_policies(fc.simplify, fc.netpols)
     want = _oracle_table(policy, fc.tiers, fc.pods, fc.namespaces, fc.cases)
     n = len(fc.pods)
@@ -529,6 +545,9 @@ def run_seed(
                             f"dst={fc.pods[di][:2]}: {got_p} != {want_p}"
                         )
                     pair_checks += 1
+    lint_by_check: Dict[str, int] = {}
+    for w in lint_warnings:
+        lint_by_check[w.check] = lint_by_check.get(w.check, 0) + 1
     return {
         "seed": seed,
         "pods": n,
@@ -537,6 +556,8 @@ def run_seed(
         "mesh_cells": mesh_cells,
         "pair_checks": pair_checks,
         "anp_count": 0 if fc.tiers is None else len(fc.tiers.anps),
+        "lint_warnings": len(lint_warnings),
+        "lint_warnings_by_check": lint_by_check,
     }
 
 
@@ -566,11 +587,16 @@ def run(
         report.mesh_cells_checked += r["mesh_cells"]
         report.pair_checks += r["pair_checks"]
         report.tiered_seeds += int(r["tiered"])
+        report.lint_warnings += r["lint_warnings"]
+        for check, n_w in r["lint_warnings_by_check"].items():
+            report.lint_warnings_by_check[check] = (
+                report.lint_warnings_by_check.get(check, 0) + n_w
+            )
         if log is not None:
             log(
                 f"seed {s}: pods={r['pods']} anps={r['anp_count']} "
                 f"tiered={r['tiered']} cells={r['cells']} "
-                f"mesh={r['mesh_cells']} OK"
+                f"mesh={r['mesh_cells']} lint={r['lint_warnings']} OK"
             )
     return report
 

@@ -205,6 +205,30 @@ class TestCacheModule:
         assert aot_cache.make_key("a", "s", schedule="ring", plan="p") != base
         assert aot_cache.make_key("a", "s", schedule="single", plan="q") != base
 
+    def test_platform_stamp_covers_jaxlib_independently(self):
+        """Key-omission regression (tools/cachelint.py audit): the
+        serialized payload is a JAXLIB binary, and jaxlib can be pinned
+        independently of jax — a jaxlib-only upgrade must invalidate,
+        not adopt."""
+        import jax
+        import jaxlib
+
+        stamp = aot_cache.platform_stamp()
+        assert f"jax={jax.__version__}" in stamp
+        assert "jaxlib=" in stamp
+        base_key = aot_cache.make_key("a", "s")
+        orig = jaxlib.__version__
+        try:
+            jaxlib.__version__ = orig + ".post1"
+            assert aot_cache.platform_stamp() != stamp
+            # and the full key follows the stamp: a jaxlib-only bump
+            # must miss every persisted executable
+            assert aot_cache.make_key("a", "s") != base_key
+        finally:
+            jaxlib.__version__ = orig
+        assert aot_cache.platform_stamp() == stamp  # revert hits
+        assert aot_cache.make_key("a", "s") == base_key
+
     def test_aot_program_round_trip_in_process(self, tmp_path, monkeypatch):
         """AotProgram stores on first call and a FRESH wrapper adopts
         from disk (load path exercised without a subprocess)."""

@@ -23,6 +23,12 @@ def run_bench(env_extra, timeout=120):
     env.setdefault("CYCLONUS_JAX_CACHE", "0")
     env.setdefault("CYCLONUS_AOT_CACHE", "0")
     env.setdefault("CYCLONUS_AUTOTUNE_CACHE", "0")
+    # hermetic cache-key registry too: a developer shell that exported
+    # CYCLONUS_KEYHARNESS=1 (the key-mutation harness env) would arm the
+    # registry in the subprocess and flip the key_audit/strip-proof
+    # asserts — hard-pin, not setdefault, because an exported "1"
+    # survives setdefault
+    env["CYCLONUS_KEYHARNESS"] = "0"
     # pin CPU inside the subprocess: the env var alone is overridden by
     # the axon sitecustomize on TPU machines (tests/conftest.py docstring)
     env.update(env_extra)
@@ -243,6 +249,10 @@ class TestBenchGuards:
         for k in ("hits", "misses", "adopted", "compiles"):
             assert aot[k] == 0
         assert aot["dir"] is None
+        # the cache-key registry census (utils/cachekeys.py): inert
+        # outside the key-mutation harness env, so the audit records
+        # inactive with zero registrations
+        assert cold["key_audit"] == {"active": False, "registered": 0}
         # detail.chaos rides EVERY line like detail.mesh: on this CPU
         # run the auto mode skips the leg but the schema still appears
         chaos_detail = detail["chaos"]
@@ -299,6 +309,14 @@ class TestBenchGuards:
         # first check) — its ABSENCE here proves the production strip
         # is real, not just cheap
         assert "cyclonus_tpu_contract_checks_total" not in tel["metrics"]
+        # same strip proof for the cache-key registry instruments
+        # (utils/cachekeys.py): they register only under
+        # CYCLONUS_KEYHARNESS=1, so a production BENCH line never
+        # carries them
+        assert not any(
+            name.startswith("cyclonus_tpu_cachekey")
+            for name in tel["metrics"]
+        )
         assert "engine.dispatch" in tel["phases"]
         assert any(
             e["path"].startswith("counts.") for e in tel["flight_recorder"]
