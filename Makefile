@@ -74,6 +74,18 @@ parity-compressed:
 	  python -m pytest tests/test_engine_parity.py \
 	  tests/test_engine_classes.py -q
 
+# the TSS/LPM CIDR pre-classification parity gate (docs/DESIGN.md
+# "CIDR tuple-space pre-classification"): the trie stage FORCED on
+# (CYCLONUS_CIDR_TSS=1) under class compression with the runtime tensor
+# contracts live, through the full parity suite + the dedicated CIDR
+# suite, plus the adversarial CIDR fuzz family (dense == compressed ==
+# TSS == oracle, mesh leg included)
+parity-cidr:
+	CYCLONUS_SHAPE_CHECK=1 CYCLONUS_CIDR_TSS=1 CYCLONUS_CLASS_COMPRESS=1 \
+	  JAX_PLATFORMS=cpu python -m pytest tests/test_engine_parity.py \
+	  tests/test_engine_cidr.py -q
+	JAX_PLATFORMS=cpu python -m cyclonus_tpu fuzz --seeds 0 --cidr-seeds 4
+
 # verdict-service smoke (docs/DESIGN.md "Verdict service"): start a real
 # `cyclonus-tpu serve` subprocess, apply a delta batch over the wire
 # (asserting the single-pod delta takes the INCREMENTAL path), query,
@@ -105,7 +117,7 @@ chaos:
 # smoke the verdict service and the 8-device overlapped mesh path, run
 # the seeded tier fuzz gate (mesh leg included), run the chaos suite,
 # then run the suite on a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed serve-smoke multichip-smoke fuzz chaos
+check: vet lint perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke fuzz chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -154,4 +166,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint shapelint cachelint keyharness perf-gate parity-compressed serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint shapelint cachelint keyharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker

@@ -1286,6 +1286,224 @@ def _tiers_leg(cases, n_pods: int, n_policies: int):
     }
 
 
+def cidr_case(cases, headline_pods: int, headline_policies: int) -> dict:
+    """BENCH cidr leg (detail.cidr): the TSS/LPM CIDR pre-classification
+    stage (docs/DESIGN.md "CIDR tuple-space pre-classification") on a
+    synthetic ipBlock-heavy cluster — BENCH_CIDR_DISTINCT distinct
+    (base, mask, excepts) rows over BENCH_CIDR_PODS pods drawn from a
+    bounded IP pool (the regime where IP structure, not labels, carries
+    the signature entropy).  Records {active, distinct_cidrs,
+    partitions, classes, ratio, lpm_s} plus the measured dense-vs-TSS
+    throughput comparison: the TSS-compressed engine must beat the
+    dense engine's rate (asserted at >= 512 pods; smaller guard shapes
+    record without asserting), with counts cross-checked bit-identical
+    on a shared sub-cluster and scalar-oracle pair spot checks."""
+    import random as _random
+
+    from cyclonus_tpu.engine import TpuPolicyEngine
+    from cyclonus_tpu.kube.netpol import (
+        IPBlock,
+        LabelSelector,
+        NetworkPolicy,
+        NetworkPolicyEgressRule,
+        NetworkPolicyIngressRule,
+        NetworkPolicyPeer,
+        NetworkPolicySpec,
+    )
+    from cyclonus_tpu.matcher import build_network_policies
+
+    n_pods = int(
+        os.environ.get("BENCH_CIDR_PODS", "0")
+    ) or min(1024, headline_pods)
+    distinct = int(
+        os.environ.get("BENCH_CIDR_DISTINCT", "0")
+    ) or min(512, max(64, headline_policies))
+    pool = int(os.environ.get("BENCH_CIDR_IP_POOL", "0")) or 64
+    rng = _random.Random(424242)
+    namespaces = {"cidr": {"ns": "cidr"}}
+    ip_pool = sorted(
+        {
+            f"10.{rng.randrange(64)}.{rng.randrange(256)}"
+            f".{rng.randrange(1, 255)}"
+            for _ in range(pool)
+        }
+    )
+    # two label shapes on purpose: the signature entropy must come from
+    # the CIDR structure, which is exactly what the TSS stage compresses
+    pods = [
+        ("cidr", f"p{i}", {"app": f"app{i % 2}"}, ip_pool[i % len(ip_pool)])
+        for i in range(n_pods)
+    ]
+    # the distinct-CIDR corpus: /32 splinters on the pod pool's /24s
+    # (membership actually varies) plus an UNBOUNDED /32 family over
+    # 10.0.0.0/10 (~4.2M candidates — what lets BENCH_CIDR_DISTINCT
+    # reach the 100k acceptance shape; pool-only families cap at ~49k
+    # and the rejection loop would spin forever), /24 and /16 ladders,
+    # excepts.  The attempts bound keeps a pathological request (past
+    # the family capacity) from hanging the leg: it runs with what it
+    # got, and requested_distinct vs distinct_cidrs records the gap.
+    cidrs: list = []
+    seen = set()
+    attempts = 0
+    while len(cidrs) < distinct and attempts < 64 * distinct:
+        attempts += 1
+        roll = rng.random()
+        if roll < 0.30:
+            ip = rng.choice(ip_pool)
+            a, b, c, _d = ip.split(".")
+            cand = (f"{a}.{b}.{c}.{rng.randrange(256)}/32", ())
+        elif roll < 0.55:
+            cand = (
+                f"10.{rng.randrange(64)}.{rng.randrange(256)}"
+                f".{rng.randrange(256)}/32",
+                (),
+            )
+        elif roll < 0.80:
+            cand = (
+                f"10.{rng.randrange(64)}.{rng.randrange(256)}.0/24",
+                (),
+            )
+        elif roll < 0.92:
+            b2 = rng.randrange(64)
+            cand = (f"10.{b2}.0.0/16", (f"10.{b2}.{rng.randrange(256)}.0/24",))
+        else:
+            cand = (f"10.{rng.randrange(64)}.0.0/{rng.choice((12, 14, 15))}", ())
+        if cand not in seen:
+            seen.add(cand)
+            cidrs.append(cand)
+    per_rule = 64
+    netpols = []
+    for i in range(0, len(cidrs), per_rule):
+        chunk = cidrs[i : i + per_rule]
+        peers = [
+            NetworkPolicyPeer(ip_block=IPBlock.make(c, list(ex)))
+            for c, ex in chunk
+        ]
+        netpols.append(
+            NetworkPolicy(
+                name=f"cidr-{i // per_rule}",
+                namespace="cidr",
+                spec=NetworkPolicySpec(
+                    pod_selector=LabelSelector.make(),
+                    policy_types=["Ingress", "Egress"],
+                    ingress=[NetworkPolicyIngressRule(ports=[], from_=peers)],
+                    egress=[NetworkPolicyEgressRule(ports=[], to=peers)],
+                ),
+            )
+        )
+    policy = build_network_policies(True, netpols)
+    t0 = time.perf_counter()
+    engine = TpuPolicyEngine(
+        policy, pods, namespaces, class_compress="1", cidr_tss="1"
+    )
+    build_s = time.perf_counter() - t0
+    out = {
+        "pods": n_pods,
+        "requested_distinct": distinct,
+        "build_s": round(build_s, 3),
+    }
+    out.update(engine.cidr_stats())
+    cc = engine.class_compression_stats()
+    out["classes"] = cc.get("classes")
+    out["ratio"] = cc.get("ratio")
+    out["hbm_budget_ok"] = engine._class_counts_eligible(len(cases))
+    # steady-state TSS-compressed counts rate
+    counts = engine.evaluate_grid_counts(cases)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        counts = engine.evaluate_grid_counts(cases)
+        times.append(time.perf_counter() - t0)
+    out["eval_s"] = round(min(times), 4)
+    out["cells_per_sec"] = round(counts["cells"] / min(times))
+    # oracle spot parity through the pairs kernel (raises on divergence)
+    n_samples = int(os.environ.get("BENCH_CIDR_SAMPLE", "6"))
+    spot_check_pairs(engine, policy, pods, namespaces, cases, n_samples, rng)
+    out["parity_spot_checks"] = n_samples
+    # dense twin on a bounded sub-cluster: the measured comparison plus
+    # a bit-identity cross-check of the two paths' counts
+    n_dense = min(n_pods, int(os.environ.get("BENCH_CIDR_DENSE_PODS", "512")))
+    sub_pods = pods[:n_dense]
+    dense_engine = TpuPolicyEngine(
+        policy, sub_pods, namespaces, class_compress="0", cidr_tss="0"
+    )
+    dense_counts = dense_engine.evaluate_grid_counts(cases)
+    d_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dense_counts = dense_engine.evaluate_grid_counts(cases)
+        d_times.append(time.perf_counter() - t0)
+    dense_rate = dense_counts["cells"] / min(d_times)
+    out["dense"] = {
+        "pods": n_dense,
+        "eval_s": round(min(d_times), 4),
+        "cells_per_sec": round(dense_rate),
+    }
+    # the SHAPE-MATCHED twin: the same sub-cluster through a TSS engine,
+    # both the bit-identity cross-check AND the timed side of the
+    # throughput gate — comparing the full-shape TSS rate against a
+    # smaller dense grid would let fixed dispatch overhead amortize
+    # differently and mask a real TSS regression
+    sub_tss = TpuPolicyEngine(
+        policy, sub_pods, namespaces, class_compress="1", cidr_tss="1"
+    )
+    sub_counts = sub_tss.evaluate_grid_counts(cases)
+    if sub_counts != dense_counts:
+        raise AssertionError(
+            f"BENCH CIDR: TSS-compressed counts diverge from dense on "
+            f"the shared sub-cluster: {sub_counts} != {dense_counts}"
+        )
+    s_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sub_tss.evaluate_grid_counts(cases)
+        s_times.append(time.perf_counter() - t0)
+    sub_tss_rate = sub_counts["cells"] / min(s_times)
+    out["tss_at_dense_shape"] = {
+        "pods": n_dense,
+        "eval_s": round(min(s_times), 4),
+        "cells_per_sec": round(sub_tss_rate),
+    }
+    out["speedup_vs_dense"] = round(sub_tss_rate / max(dense_rate, 1e-9), 2)
+    # the dense-vs-TSS throughput gate, same pods on both sides: at real
+    # shapes the compressed grid must beat the dense one
+    # (BENCH_CIDR_MIN_SPEEDUP scales the bound); tiny guard shapes
+    # record the ratio without asserting
+    min_speedup = float(os.environ.get("BENCH_CIDR_MIN_SPEEDUP", "1.0"))
+    if n_dense >= 512 and out["speedup_vs_dense"] < min_speedup:
+        raise AssertionError(
+            f"BENCH CIDR: TSS throughput {round(sub_tss_rate)} cells/s "
+            f"did not beat dense {round(dense_rate)} cells/s at "
+            f"{n_dense} pods (speedup {out['speedup_vs_dense']} < "
+            f"{min_speedup})"
+        )
+    return out
+
+
+def _cidr_leg(cases, n_pods: int, n_policies: int):
+    """Bounded wrapper for the cidr leg (BENCH_CIDR=0 skips; skipped
+    legs still record {active: False} so detail.cidr appears on every
+    line).  Correctness failures re-raise loudly like the tiers leg's."""
+    if os.environ.get("BENCH_CIDR", "1") != "1":
+        return {"active": False, "skipped": "BENCH_CIDR=0"}
+    from cyclonus_tpu.utils.bounded import run_bounded
+
+    _stall_env = float(os.environ.get("BENCH_STALL_S", "300"))
+    _bound = min(240.0, _stall_env * 0.8) if _stall_env > 0 else 600.0
+    status, value = run_bounded(
+        lambda: cidr_case(cases, n_pods, n_policies), _bound
+    )
+    if status == "ok":
+        return value
+    if status == "error" and isinstance(value, AssertionError):
+        raise value
+    return {
+        "active": False,
+        "status": status,
+        "error": None if status == "timeout" else repr(value),
+    }
+
+
 def mega_class_case(cases) -> dict:
     """The 1M-pod synthetic-cluster case (ROADMAP item 2): a cluster an
     order of magnitude past the headline shape, evaluable on one chip
@@ -1847,6 +2065,8 @@ def _bench(done):
         tel_snapshot = telemetry.snapshot()
         _enter_phase("tiers")
         tiers_detail = _tiers_leg(cases, n_pods, n_policies)
+        _enter_phase("cidr")
+        cidr_detail = _cidr_leg(cases, n_pods, n_policies)
         _enter_phase("serve_churn")
         serve_detail = _serve_churn_leg(cases, n_pods, n_policies)
         _enter_phase("chaos")
@@ -1951,6 +2171,14 @@ def _bench(done):
                         # (perfobs reads detail.tiers on every line,
                         # warn-only like class_compression)
                         "tiers": tiers_detail,
+                        # the TSS/LPM CIDR pre-classification leg
+                        # (BENCH_CIDR=0 skips, still recording
+                        # {active: False}): distinct CIDRs/partitions/
+                        # classes/lpm_s with the dense-vs-TSS throughput
+                        # comparison asserted and counts cross-checked
+                        # (perfobs reads detail.cidr on every line,
+                        # warn-only like class_compression)
+                        "cidr": cidr_detail,
                         # the 1M-pod synthetic case (BENCH_MEGA): the
                         # compression-only shape, with its own
                         # class_compression block, HBM-budget check,

@@ -56,6 +56,18 @@ def setup_fuzz(sub) -> None:
         help="evaluate_pairs spot checks per seed (default 16)",
     )
     p.add_argument(
+        "--cidr-seeds",
+        type=int,
+        default=6,
+        metavar="N",
+        help="seeds of the adversarial CIDR family (overlapping "
+        "prefixes, /31-/32 splinters, /0 full cover, except==cidr "
+        "annihilation, three-deep excepts, v4/v6 mixes) pinned "
+        "dense==compressed==TSS==oracle incl. the mesh leg "
+        "(default 6; 0 skips; docs/DESIGN.md 'CIDR tuple-space "
+        "pre-classification')",
+    )
+    p.add_argument(
         "--conformance",
         action="store_true",
         help="also run the generator's ANP/BANP conformance family "
@@ -97,6 +109,7 @@ def _run_fuzz(args) -> int:
             check_counts=not args.no_counts,
             check_mesh=not args.no_mesh,
             pair_samples=args.pair_samples,
+            cidr_seeds=args.cidr_seeds,
             log=log,
         )
         conformance = (
@@ -121,6 +134,12 @@ def _run_fuzz(args) -> int:
             f"({out['tiered_seeds']} tiered), {out['cells_checked']} "
             f"truth-table cells ({out['mesh_cells_checked']} re-checked "
             f"via the overlapped mesh), {out['pair_checks']} pair checks"
+            + (
+                f", {len(out['cidr_seeds'])} CIDR seeds "
+                f"({out['cidr_cells_checked']} cells)"
+                if out.get("cidr_seeds")
+                else ""
+            )
             + (
                 f", {conformance} conformance cases"
                 if conformance is not None

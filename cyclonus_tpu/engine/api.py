@@ -777,6 +777,7 @@ class TpuPolicyEngine:
         *,
         compact: Optional[bool] = None,
         class_compress: Optional[str] = None,
+        cidr_tss: Optional[str] = None,
         tiers=None,
         slab_headroom: int = 0,
     ):
@@ -798,6 +799,10 @@ class TpuPolicyEngine:
         ensure_persistent_compile_cache()
         self._opt_compact = compact
         self._opt_class_compress = class_compress
+        # cidr_tss overrides CYCLONUS_CIDR_TSS for the TSS/LPM CIDR
+        # pre-classification stage (engine/cidrspace.py; docs/DESIGN.md
+        # "CIDR tuple-space pre-classification") — None = env
+        self._opt_cidr_tss = cidr_tss
         # rule-slab headroom (extra _bucket_dim steps pre-reserved on
         # the selector/target/peer/tier row buckets).  0 for batch
         # engines; the serve path passes CYCLONUS_SERVE_HEADROOM so
@@ -868,10 +873,17 @@ class TpuPolicyEngine:
                 # buffer — counted against CYCLONUS_SLAB_MAX_BYTES by
                 # the slab plan and the compressed-counts eligibility
                 cb = int(st["ctensors"]["pod_ns_id"].shape[0])
+                # the TSS partition tensors (trie map) charge the same
+                # budget: the LPM stage must never over-commit the HBM
+                # the compression exists to save
+                cidr_bytes = (
+                    st["cidr"].nbytes() if st.get("cidr") is not None else 0
+                )
                 st["aux_bytes"] = int(
                     self.encoding.cluster.n_pods * 4
                     + cb * 4
                     + sum(a.nbytes for a in _np_leaves(st["ctensors"]))
+                    + cidr_bytes
                 )
                 ti.CLASS_AUX_BYTES.set(st["aux_bytes"])
         # wall-clock of the last tiered grid evaluation's dispatch
@@ -1102,8 +1114,20 @@ class TpuPolicyEngine:
             selpod = self._selpod_prebucket = _selector_pod_matches_host(
                 self._tensors
             )
+        # TSS/LPM CIDR pre-classification (engine/cidrspace.py): when the
+        # stage resolves (CYCLONUS_CIDR_TSS gate + distinct-spec floor +
+        # HBM budget), the class signature's CIDR dimension rides the
+        # [K] int32 partition signature instead of per-spec bits — the
+        # O(specs)->O(partitions) cut that keeps classification feasible
+        # on CIDR-heavy sets.  None = the dense bit path, byte-identical
+        # to the pre-TSS signature.
+        from . import cidrspace
+
+        space = cidrspace.resolve(
+            self._tensors, mode=self._opt_cidr_tss, n_pods=n
+        )
         with phase("engine.classify"):
-            pc = compute_pod_classes(self._tensors, selpod)
+            pc = compute_pod_classes(self._tensors, selpod, cidr=space)
         if mode != "1" and pc.n_classes > int(0.9 * n):
             return  # no real reduction: the second tensor set isn't worth it
         self._class_state = {
@@ -1112,6 +1136,7 @@ class TpuPolicyEngine:
             "ctensors_raw": gather_class_pod_rows(self._tensors, pc.class_rep),
             "aux_bytes": 0,  # finalized after bucketing (engine __init__)
             "last_gather_s": None,
+            "cidr": space,
         }
         ti.CLASS_PODS.set(n)
         ti.CLASS_COUNT.set(pc.n_classes)
@@ -1158,6 +1183,36 @@ class TpuPolicyEngine:
             "signature_bytes": pc.signature_bytes,
             "aux_bytes": st["aux_bytes"],
             "partitions": self._partition_stats,
+        }
+
+    def cidr_stats(self) -> Dict:
+        """The TSS/LPM CIDR pre-classification summary (bench.py records
+        it under detail.cidr): whether the stage is active, the distinct
+        spec/atom/partition counts, the last LPM stage wall-clock and
+        whether it ran on device, and the partition-tensor bytes charged
+        to the HBM budget."""
+        st = self._class_state
+        space = st.get("cidr") if st is not None else None
+        if space is None:
+            return {
+                "active": False,
+                "distinct_cidrs": None,
+                "atoms": None,
+                "partitions": None,
+                "lpm_s": None,
+                "device": None,
+                "bytes": 0,
+            }
+        return {
+            "active": True,
+            "distinct_cidrs": space.n_specs,
+            "atoms": space.n_atoms,
+            "partitions": space.n_partitions,
+            "max_bucket": space.max_bucket,
+            "host_rows": space.n_host_rows,
+            "lpm_s": space.last_lpm_s,
+            "device": space.last_device,
+            "bytes": space.nbytes(),
         }
 
     def tier_stats(self) -> Dict:

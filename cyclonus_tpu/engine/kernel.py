@@ -275,6 +275,40 @@ def packed_any(a_pk: jnp.ndarray, b_pk: jnp.ndarray) -> jnp.ndarray:
     return acc != 0
 
 
+@contracts.args(
+    pod_ip="(N,) uint32",
+    pod_ip_valid="(N,) bool",
+    pmask="(K,) uint32",
+    pbases="(K, B) uint32",
+    pindex="(K, B) int32",
+)
+def lpm_partition_signature(
+    pod_ip: jnp.ndarray,  # [N] uint32
+    pod_ip_valid: jnp.ndarray,  # [N] bool
+    pmask: jnp.ndarray,  # [K] uint32 partition masks (LPM order)
+    pbases: jnp.ndarray,  # [K, B] uint32 sorted bases, 0xFFFFFFFF pad
+    pindex: jnp.ndarray,  # [K, B] int32 global atom ids, -1 pad
+) -> jnp.ndarray:
+    """[K, N] int32 TSS/LPM partition signature (docs/DESIGN.md "CIDR
+    tuple-space pre-classification"): the global atom index pod n's IP
+    matches within partition k, or -1 (no base equals pod_ip & pmask[k],
+    or the IP is invalid).  Within a partition at most one base can
+    match — pod_ip & mask is one value — so the leftmost binary search
+    over the sorted bases is the whole trie walk.  Bit-identical to the
+    numpy twin cidrspace.CidrSpace.signature_host (pinned by
+    tests/test_engine_cidr.py); pad slots are rejected by their -1
+    pindex, never by the pad base value, so a real 255.255.255.255 base
+    (which ties the pad and wins the leftmost search) still resolves."""
+    key = pod_ip[None, :] & pmask[:, None]  # [K, N] uint32
+    pos = jax.vmap(partial(jnp.searchsorted, side="left"))(pbases, key)
+    pos = jnp.minimum(pos, pbases.shape[1] - 1)  # [K, N]
+    hit = jnp.take_along_axis(pbases, pos, axis=1) == key
+    idx = jnp.take_along_axis(pindex, pos, axis=1)
+    return jnp.where(
+        hit & (idx >= 0) & pod_ip_valid[None, :], idx, jnp.int32(-1)
+    ).astype(jnp.int32)
+
+
 def m_tp_onehot(enc: Dict) -> jnp.ndarray:
     """[T, P] bool peer->target one-hot, built ON DEVICE from the [P]
     peer_target index vector.  The dense matrix reaches ~70 MB at the

@@ -195,6 +195,19 @@ def _bench_run_from_parsed(
             run.tiers_anp_count = int(tiers["anp_count"])
         if isinstance(tiers.get("resolve_s"), (int, float)):
             run.tiers_resolve_s = float(tiers["resolve_s"])
+    cidr = detail.get("cidr")
+    if isinstance(cidr, dict):
+        run.cidr_active = bool(cidr.get("active"))
+        if isinstance(cidr.get("distinct_cidrs"), int):
+            run.cidr_distinct = int(cidr["distinct_cidrs"])
+        if isinstance(cidr.get("partitions"), int):
+            run.cidr_partitions = int(cidr["partitions"])
+        if isinstance(cidr.get("classes"), int):
+            run.cidr_classes = int(cidr["classes"])
+        if isinstance(cidr.get("ratio"), (int, float)):
+            run.cidr_ratio = float(cidr["ratio"])
+        if isinstance(cidr.get("lpm_s"), (int, float)):
+            run.cidr_lpm_s = float(cidr["lpm_s"])
     roofline = detail.get("roofline")
     if isinstance(roofline, dict) and isinstance(
         roofline.get("efficiency_vs_roofline"), (int, float)

@@ -98,6 +98,17 @@ class PerfRun:
     tiers_active: bool = False
     tiers_anp_count: Optional[int] = None
     tiers_resolve_s: Optional[float] = None
+    # detail.cidr — the TSS/LPM CIDR pre-classification leg (None/False:
+    # leg skipped or an older artifact).  Warn-only in the sentinel like
+    # class_compression_ratio: the leg's own throughput assertion and
+    # oracle spot parity already fail the bench on correctness, so
+    # lpm_s gates only trends (>2x degradation vs baseline best warns).
+    cidr_active: bool = False
+    cidr_distinct: Optional[int] = None
+    cidr_partitions: Optional[int] = None
+    cidr_classes: Optional[int] = None
+    cidr_ratio: Optional[float] = None
+    cidr_lpm_s: Optional[float] = None
     # detail.roofline.efficiency_vs_roofline — measured eval vs the
     # analytic limit for the shapes it ran (None: older artifact or
     # roofline skipped).  Gated >= min_roofline_efficiency on NEW runs
@@ -158,6 +169,12 @@ class PerfRun:
             "tiers_active": self.tiers_active,
             "tiers_anp_count": self.tiers_anp_count,
             "tiers_resolve_s": self.tiers_resolve_s,
+            "cidr_active": self.cidr_active,
+            "cidr_distinct": self.cidr_distinct,
+            "cidr_partitions": self.cidr_partitions,
+            "cidr_classes": self.cidr_classes,
+            "cidr_ratio": self.cidr_ratio,
+            "cidr_lpm_s": self.cidr_lpm_s,
             "roofline_efficiency": self.roofline_efficiency,
             "pack_active": self.pack_active,
             "pack_dtype": self.pack_dtype,

@@ -49,7 +49,7 @@ from .schema import PerfRun
 #: knobs shape the leg)
 _DEDICATED_PHASES = frozenset(
     {"warmup", "eval", "backend_init_join", "serve_churn", "tiers",
-     "chaos"}
+     "cidr", "chaos"}
 )
 
 
@@ -416,6 +416,26 @@ def gate(
                 f"{best_resolve:g}s — reported only (warn, not fail); "
                 "check the tier resolution epilogue before the next "
                 "round"
+            )
+
+    # --- CIDR TSS leg: WARN, never fail ---------------------------------
+    # same posture class_compression_ratio took when it landed: the
+    # leg's own dense-vs-TSS throughput assertion and oracle spot
+    # parity already fail the bench on correctness, so the LPM stage
+    # wall-clock gates only trends
+    lpm_base = [
+        r.cidr_lpm_s
+        for r in baselines
+        if isinstance(r.cidr_lpm_s, (int, float))
+    ]
+    if lpm_base and isinstance(candidate.cidr_lpm_s, (int, float)):
+        best_lpm = min(lpm_base)
+        if candidate.cidr_lpm_s > 2.0 * best_lpm:
+            notes.append(
+                "WARNING: cidr_lpm_s degraded >2x vs baseline: "
+                f"candidate {candidate.cidr_lpm_s:g}s vs best "
+                f"{best_lpm:g}s — reported only (warn, not fail); "
+                "check the LPM partition stage before the next round"
             )
 
     # --- per-phase bounds: every phase both sides know ------------------

@@ -263,6 +263,10 @@ class IncrementalEngine:
         self._sigs: Optional[np.ndarray] = None
         self._selpod: Optional[np.ndarray] = None
         self._class_sig_of: Dict[bytes, int] = {}
+        # (staged, space) handoff from patch_policy's structure pin to
+        # the rebuild it ends with — consumed (and reset) by
+        # rebuild_class_state, staged only after every Ineligible
+        self._resolved_cidr = (False, None)
         if self.engine._class_state is not None:
             self._init_class_support()
 
@@ -303,8 +307,13 @@ class IncrementalEngine:
         self._selpod = engine_api._selector_pod_matches_host(
             self._raw_selector_view()
         )
+        # the engine's resolved CidrSpace (or None = dense bits) rides
+        # every signature computation: build and serve must read the
+        # SAME partition map or row widths/values would diverge
         self._sigs = pod_signatures(
-            self._sig_view(np.arange(n)), self._selpod
+            self._sig_view(np.arange(n)),
+            self._selpod,
+            cidr=eng._class_state.get("cidr"),
         )
         pc = eng._class_state["classes"]
         self._class_sig_of = {
@@ -520,7 +529,9 @@ class IncrementalEngine:
         )[:, 0]
         self._selpod[:, i] = col
         sig = pod_signatures(
-            self._sig_view(np.array([i])), self._selpod[:, i : i + 1]
+            self._sig_view(np.array([i])),
+            self._selpod[:, i : i + 1],
+            cidr=eng._class_state.get("cidr"),
         )[0]
         if sig.shape[0] != self._sigs.shape[1]:
             self.rebuild_class_state()
@@ -562,8 +573,27 @@ class IncrementalEngine:
         self._selpod = engine_api._selector_pod_matches_host(
             self._raw_selector_view()
         )
+        # re-resolve the TSS partition map from the CURRENT tensors: a
+        # same-structure policy patch may have changed atom membership
+        # within existing masks (patch_policy pins the MASK structure
+        # itself — a new mask structure went Ineligible before any
+        # mutation), and the stale map would compute stale signatures.
+        # A patch_policy call stashes the space it already resolved for
+        # the structure pin (same spec set — see _resolved_cidr) so the
+        # policy-delta hot path derives it once, not twice.
+        stashed, space = getattr(self, "_resolved_cidr", (False, None))
+        self._resolved_cidr = (False, None)
+        if not stashed:
+            from ..engine import cidrspace
+
+            space = cidrspace.resolve(
+                eng._tensors, mode=eng._opt_cidr_tss, n_pods=n
+            )
+        st["cidr"] = space
         self._sigs = pod_signatures(
-            self._sig_view(np.arange(n)), self._selpod
+            self._sig_view(np.arange(n)),
+            self._selpod,
+            cidr=st["cidr"],
         )
         pc = classes_from_signatures(self._sigs)
         self._class_sig_of = {
@@ -588,6 +618,7 @@ class IncrementalEngine:
         st["aux_bytes"] = int(
             n * 4 + cb * 4
             + sum(a.nbytes for a in engine_api._np_leaves(ct))
+            + (st["cidr"].nbytes() if st["cidr"] is not None else 0)
         )
         st["last_gather_s"] = None
         # class buffer device state rebuilds lazily from the new host set
@@ -639,6 +670,18 @@ class IncrementalEngine:
                 "changed policy set introduces host-evaluated (IPv6) "
                 "IPBlock rows"
             )
+        # TSS partition-map pin (docs/DESIGN.md "CIDR tuple-space
+        # pre-classification"): when the live class state rides the LPM
+        # stage, a policy delta that changes the MASK structure — a new
+        # prefix length appearing, one disappearing, or the stage
+        # flipping active/inactive — changes the very shape of every pod
+        # signature.  That must be a full rebuild, checked BEFORE any
+        # state mutates: patching first and reclassifying after would
+        # leave a window where the engine's cached partition map
+        # disagrees with its rule slabs.  Atom churn WITHIN existing
+        # masks stays patchable (rebuild_class_state re-resolves the
+        # map after the slabs land).  Checked below once the new
+        # direction tensor dicts exist.
         new: Dict = {
             "sel_req_kv": sel_arrays[0],
             "sel_exp_op": sel_arrays[1],
@@ -652,6 +695,21 @@ class IncrementalEngine:
                 "ingress": engine_api._tier_tensors(tier_enc[0]),
                 "egress": engine_api._tier_tensors(tier_enc[1]),
             }
+        if eng._class_state is not None:
+            from ..engine import cidrspace
+
+            new_space = cidrspace.resolve(
+                {"ingress": new["ingress"], "egress": new["egress"]},
+                mode=eng._opt_cidr_tss,
+                n_pods=eng.encoding.cluster.n_pods,
+            )
+            if cidrspace.mask_structure(
+                eng._class_state.get("cidr")
+            ) != cidrspace.mask_structure(new_space):
+                raise Ineligible(
+                    "CIDR TSS partition structure changed (new mask "
+                    "structure): the class signature layout must rebuild"
+                )
         pstats = None
         if eng._partition_stats is not None:
             pstats = {}
@@ -835,7 +893,14 @@ class IncrementalEngine:
         ) or bool(np.any(egress.peer_kind == PEER_IP))
         if eng._class_state is not None:
             # the selector table changed: every signature's selpod block
-            # is differently shaped — classes rebuild from scratch
+            # is differently shaped — classes rebuild from scratch.  The
+            # space resolved for the structure pin above is handed over
+            # (a deterministic function of the spec set, which compress/
+            # bucketing leave unchanged) so the policy-delta hot path
+            # derives the partition map once, not twice; staged HERE —
+            # past every Ineligible — so an aborted patch can never
+            # leave a stale stash for a later rebuild to consume.
+            self._resolved_cidr = (True, new_space)
             self.rebuild_class_state()
 
     # --- buffer application ----------------------------------------------

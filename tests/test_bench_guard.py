@@ -292,6 +292,19 @@ class TestBenchGuards:
         assert tiers["anp_count"] == 3 and tiers["banp"] is True
         assert tiers["resolve_s"] > 0
         assert tiers["parity_spot_checks"] >= 1
+        # the TSS/LPM CIDR pre-classification leg rides EVERY line
+        # (perfobs reads detail.cidr warn-only): a forced-TSS engine on
+        # an ipBlock-heavy synthetic cluster with oracle spot parity and
+        # the dense-counts cross-check enforced inside the leg
+        cidr = detail["cidr"]
+        assert cidr["active"] is True
+        assert cidr["distinct_cidrs"] >= 1
+        assert cidr["partitions"] >= 1
+        assert cidr["classes"] >= 1
+        assert cidr["ratio"] >= 1
+        assert cidr["lpm_s"] is not None
+        assert cidr["parity_spot_checks"] >= 1
+        assert "speedup_vs_dense" in cidr
         # the telemetry block rides every BENCH line (and thus every
         # tunnel_wait round file): metrics incl. cache hit/miss counters
         # + HBM watermarks, span aggregates, and the flight window

@@ -1,0 +1,516 @@
+"""TSS/LPM CIDR pre-classification (docs/DESIGN.md "CIDR tuple-space
+pre-classification"; engine/cidrspace.py).
+
+Five layers of proof:
+
+  * PARTITIONS: the tuple-space builder — masks in LPM (longest prefix
+    first) order, bases sorted per bucket, atom dedup across primaries
+    and excepts, and the _mask_for_prefix(0) / /32 boundary pins.
+  * TWINS: the numpy LPM walk and the device kernel
+    (kernel.lpm_partition_signature) are BIT-IDENTICAL, including the
+    0.0.0.0 / 255.255.255.255 / invalid-IP edges, and the partition
+    signature mechanically reproduces the dense per-spec membership
+    bits (spec_membership_words — the soundness bridge).
+  * ROUTING: host-evaluated (IPv6 / mixed-family) rows never reach the
+    trie — they keep their per-pod match columns, pinned against the
+    scalar oracle.
+  * PARITY: dense == class-compressed(bit signature) ==
+    class-compressed(forced TSS) == scalar oracle across the
+    adversarial CIDR fuzz family, grid + counts + the overlapped mesh
+    path (tiers/fuzz.run_cidr_seed — the same gate `make parity-cidr`
+    and `make fuzz` run).
+  * GATING/SERVE: CYCLONUS_CIDR_TSS=0 restores byte-identical
+    signatures, auto mode respects the distinct-spec floor and the HBM
+    budget (aux accounting included), and a serve policy delta that
+    changes the partition mask structure goes Ineligible -> full
+    rebuild instead of patching over a stale map.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cyclonus_tpu.engine import PortCase, TpuPolicyEngine
+from cyclonus_tpu.engine import cidrspace
+from cyclonus_tpu.engine.encoding import (
+    _mask_for_prefix,
+    pack_bool_words,
+    pod_signatures,
+)
+from cyclonus_tpu.telemetry import instruments as ti
+from cyclonus_tpu.kube.netpol import (
+    IPBlock,
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyEgressRule,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicySpec,
+)
+from cyclonus_tpu.matcher import build_network_policies
+
+CASES = [
+    PortCase(80, "serve-80-tcp", "TCP"),
+    PortCase(81, "serve-81-udp", "UDP"),
+]
+
+
+def mk_np(name, ns, blocks, selector=None, ingress=True, egress=True):
+    peers = [NetworkPolicyPeer(ip_block=b) for b in blocks]
+    spec = NetworkPolicySpec(
+        pod_selector=selector or LabelSelector.make(),
+        policy_types=(["Ingress"] if ingress else [])
+        + (["Egress"] if egress else []),
+    )
+    if ingress:
+        spec.ingress = [NetworkPolicyIngressRule(ports=[], from_=peers)]
+    if egress:
+        spec.egress = [NetworkPolicyEgressRule(ports=[], to=peers)]
+    return NetworkPolicy(name=name, namespace=ns, spec=spec)
+
+
+def mk_cluster(ips):
+    namespaces = {"x": {"ns": "x"}}
+    pods = [
+        ("x", f"p{i}", {"app": f"a{i % 2}"}, ip) for i, ip in enumerate(ips)
+    ]
+    return pods, namespaces
+
+
+def build_engine(blocks, ips, **kw):
+    pods, namespaces = mk_cluster(ips)
+    policy = build_network_policies(True, [mk_np("np0", "x", blocks)])
+    return TpuPolicyEngine(policy, pods, namespaces, **kw), policy, pods, namespaces
+
+
+class TestPartitions:
+    def test_mask_for_prefix_boundaries(self):
+        # the /0 full cover and the /32 exact match are the two mask
+        # boundary values the partition builder leans on
+        assert _mask_for_prefix(0) == 0
+        assert _mask_for_prefix(32) == 0xFFFFFFFF
+        assert _mask_for_prefix(31) == 0xFFFFFFFE
+        assert _mask_for_prefix(8) == 0xFF000000
+
+    def _space(self, blocks, ips=("10.0.1.1",)):
+        engine, *_ = build_engine(
+            blocks, list(ips), class_compress="1", cidr_tss="1"
+        )
+        st = engine._class_state
+        return st.get("cidr") if st is not None else None, engine
+
+    def test_partitions_lpm_order_and_dedup(self):
+        space, _ = self._space([
+            IPBlock.make("10.0.0.0/8", ["10.0.1.0/24"]),
+            IPBlock.make("10.0.1.0/24", []),   # dup atom with the except
+            IPBlock.make("0.0.0.0/0", []),
+            IPBlock.make("10.0.1.7/32", []),
+        ])
+        assert space is not None
+        # masks longest-prefix-first: /32, /24, /8, /0
+        assert list(space.pprefix) == [32, 24, 8, 0]
+        assert space.pmask[-1] == 0  # the /0 partition
+        # 10.0.1.0/24 appears as a primary AND an except: one atom
+        assert space.n_atoms == 4
+        assert space.n_specs == 4
+        # bucket rows sorted ascending with -1-index pads
+        for k in range(space.n_partitions):
+            row = space.pbases[k]
+            real = row[space.pindex[k] >= 0]
+            assert np.all(np.diff(real.astype(np.int64)) > 0) or real.size <= 1
+
+    def test_annihilation_and_full_cover(self):
+        # except == cidr annihilation: membership empty; /0 matches all
+        blocks = [
+            IPBlock.make("10.0.1.0/24", ["10.0.1.0/24"]),
+            IPBlock.make("0.0.0.0/0", []),
+        ]
+        space, engine = self._space(blocks, ips=("10.0.1.9", "9.9.9.9"))
+        t = engine._tensors
+        sig = space.signature_host(t["pod_ip"][:2], t["pod_ip_valid"][:2])
+        ann = [
+            s
+            for s, (p, exs) in enumerate(space.spec_atoms)
+            if exs and p in exs  # primary annihilated by its own except
+        ]
+        full = [
+            s
+            for s, (p, exs) in enumerate(space.spec_atoms)
+            if not exs and space.atom_mask[p] == 0
+        ]
+        assert ann and full
+        valid = t["pod_ip_valid"][:2]
+        ip = t["pod_ip"][:2]
+        dense = cidrspace.dense_spec_membership(space, ip, valid)
+        assert not dense[ann[0]].any()  # annihilated
+        assert dense[full[0]].all()  # /0 covers every valid pod
+        assert np.array_equal(
+            cidrspace.spec_membership_words(space, sig),
+            pack_bool_words(dense, axis=0),
+        )
+
+
+class TestSignatureTwins:
+    def _random_space(self, seed):
+        rng = random.Random(seed)
+        blocks = []
+        for _ in range(rng.randint(4, 10)):
+            p = rng.choice((0, 8, 12, 16, 24, 31, 32, 32))
+            base = (
+                f"{rng.randrange(256)}.{rng.randrange(256)}"
+                f".{rng.randrange(256)}.{rng.randrange(256)}"
+            )
+            exs = []
+            if p <= 24 and rng.random() < 0.5:
+                exs = [f"{base.rsplit('.', 1)[0]}.0/{rng.choice((31, 32))}"]
+            blocks.append(IPBlock.make(f"{base}/{p}", exs))
+        ips = ["0.0.0.0", "255.255.255.255"] + [
+            f"{rng.randrange(256)}.{rng.randrange(256)}"
+            f".{rng.randrange(256)}.{rng.randrange(256)}"
+            for _ in range(10)
+        ] + ["fd00::1"]  # one invalid-v4 (v6) pod: pod_ip_valid False
+        engine, *_ = build_engine(
+            blocks, ips, class_compress="1", cidr_tss="1"
+        )
+        st = engine._class_state
+        return (st.get("cidr") if st else None), engine
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_host_device_bit_identity(self, seed):
+        space, engine = self._random_space(seed)
+        if space is None:
+            pytest.skip("seed generated no in-kernel v4 atoms")
+        t = engine._tensors
+        n = engine.encoding.cluster.n_pods
+        ip, valid = t["pod_ip"][:n], t["pod_ip_valid"][:n]
+        host = space.signature_host(ip, valid)
+        dev = space.signature(ip, valid, device=True)
+        assert host.dtype == np.int32 and dev.dtype == np.int32
+        assert np.array_equal(host, dev)
+        assert space.last_device is True
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_signature_reproduces_dense_membership(self, seed):
+        """The soundness bridge: per-spec membership recovered from the
+        partition signature == the dense mask-compare membership."""
+        space, engine = self._random_space(seed)
+        if space is None:
+            pytest.skip("seed generated no in-kernel v4 atoms")
+        t = engine._tensors
+        n = engine.encoding.cluster.n_pods
+        ip, valid = t["pod_ip"][:n], t["pod_ip_valid"][:n]
+        sig = space.signature_host(ip, valid)
+        dense = cidrspace.dense_spec_membership(space, ip, valid)
+        assert np.array_equal(
+            cidrspace.spec_membership_words(space, sig),
+            pack_bool_words(dense, axis=0),
+        )
+
+    def test_invalid_ip_signs_minus_one(self):
+        space, engine = self._random_space(0)
+        if space is None:
+            pytest.skip("seed generated no in-kernel v4 atoms")
+        sig = space.signature_host(
+            np.array([0], dtype=np.uint32), np.array([False])
+        )
+        assert (sig == -1).all()
+
+    def test_max_base_vs_pad_tie(self):
+        """A REAL 255.255.255.255/32 base ties the 0xFFFFFFFF bucket pad;
+        reals sort first, so the leftmost search still resolves it."""
+        engine, *_ = build_engine(
+            [IPBlock.make("255.255.255.255/32", [])],
+            ["255.255.255.255", "255.255.255.254"],
+            class_compress="1",
+            cidr_tss="1",
+        )
+        space = engine._class_state["cidr"]
+        sig = space.signature_host(
+            np.array([0xFFFFFFFF, 0xFFFFFFFE], dtype=np.uint32),
+            np.array([True, True]),
+        )
+        assert sig[0, 0] >= 0  # the real /32 hit
+        assert sig[0, 1] == -1
+
+
+class TestHostRowRouting:
+    def test_v6_rows_never_reach_the_trie(self):
+        """IPv6 CIDRs and v4-with-v6-except rows route to the host
+        column path; the trie sees only the clean v4 rows."""
+        blocks = [
+            IPBlock.make("fd00::/64", []),
+            IPBlock.make("10.0.0.0/16", ["fd00::/96"]),  # mixed family
+            IPBlock.make("10.0.1.0/24", []),
+        ]
+        engine, policy, pods, namespaces = build_engine(
+            blocks,
+            ["10.0.1.5", "10.0.2.5", "fd00::5"],
+            class_compress="1",
+            cidr_tss="1",
+        )
+        enc = engine.encoding
+        assert len(enc.ingress.host_ip_rows) == 2  # v6 + mixed
+        space = engine._class_state["cidr"]
+        assert space is not None
+        # only the clean /24 contributes an atom
+        assert space.n_atoms == 1
+        assert space.n_host_rows >= 2
+        # verdict parity against the oracle on the full table
+        from cyclonus_tpu.tiers.fuzz import _oracle_table, _engine_table
+
+        want = _oracle_table(policy, None, pods, namespaces, CASES)
+        got = _engine_table(engine, CASES)
+        assert np.array_equal(got, want)
+
+    def test_host_ip_mask_boundary_vs_oracle(self):
+        """The host_ip_mask columns (v6 rows) pinned against the oracle
+        with v6 pods on both sides of the block."""
+        blocks = [IPBlock.make("fd00:aa::/32", [])]
+        engine, policy, pods, namespaces = build_engine(
+            blocks,
+            ["fd00:aa::1", "fd00:bb::1", "10.0.0.1"],
+            class_compress="1",
+            cidr_tss="1",
+        )
+        from cyclonus_tpu.tiers.fuzz import _oracle_table, _engine_table
+
+        want = _oracle_table(policy, None, pods, namespaces, CASES)
+        got = _engine_table(engine, CASES)
+        assert np.array_equal(got, want)
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_cidr_fuzz_family(self, seed):
+        """dense == compressed(bit) == compressed(TSS) == oracle, grid +
+        counts + the overlapped mesh leg — the `make parity-cidr` gate."""
+        from cyclonus_tpu.tiers.fuzz import run_cidr_seed
+
+        r = run_cidr_seed(seed, check_mesh=True, check_counts=True)
+        assert r["cells"] > 0
+
+    def test_forced_tss_matches_bit_classes_verdicts(self):
+        """TSS classes may be FINER than bit-signature classes (except-
+        only atoms split pods) but verdicts are identical."""
+        blocks = [
+            IPBlock.make("10.0.0.0/8", ["10.0.1.0/24", "10.0.2.0/24"]),
+            IPBlock.make("10.0.1.0/24", []),
+        ]
+        ips = [f"10.0.{i % 4}.{i + 1}" for i in range(12)]
+        e_bit, policy, pods, namespaces = build_engine(
+            blocks, ips, class_compress="1", cidr_tss="0"
+        )
+        e_tss, *_ = build_engine(
+            blocks, ips, class_compress="1", cidr_tss="1"
+        )
+        assert e_tss._class_state["cidr"] is not None
+        assert e_bit._class_state.get("cidr") is None
+        assert e_tss.pod_classes().n_classes >= e_bit.pod_classes().n_classes
+        for name in ("ingress", "egress", "combined"):
+            a = np.asarray(getattr(e_bit.evaluate_grid(CASES), name))
+            b = np.asarray(getattr(e_tss.evaluate_grid(CASES), name))
+            assert np.array_equal(a, b), name
+
+
+class TestGating:
+    BLOCKS = [
+        IPBlock.make("10.0.0.0/8", []),
+        IPBlock.make("10.0.1.0/24", []),
+        IPBlock.make("10.0.1.7/32", []),
+    ]
+    IPS = ["10.0.1.7", "10.0.1.8", "10.0.2.1", "11.0.0.1"]
+
+    def test_off_is_byte_identical(self, monkeypatch):
+        """CYCLONUS_CIDR_TSS=0 restores today's signature bytes exactly
+        (the acceptance criterion's kill switch)."""
+        monkeypatch.setenv("CYCLONUS_CIDR_TSS", "0")
+        e_off, *_ = build_engine(self.BLOCKS, self.IPS, class_compress="1")
+        monkeypatch.delenv("CYCLONUS_CIDR_TSS")
+        e_env, *_ = build_engine(self.BLOCKS, self.IPS, class_compress="1")
+        # 3 distinct specs < the 256 auto floor: auto stays on bits too
+        assert e_env._class_state.get("cidr") is None
+        t_off = e_off._tensors
+        t_env = e_env._tensors
+        n = e_off.encoding.cluster.n_pods
+        sel_off = np.zeros((0, n), bool)
+        view_off = {
+            k: t_off[k][:n]
+            for k in ("pod_ns_id", "pod_ip", "pod_ip_valid")
+        }
+        view_off["ingress"] = t_off["ingress"]
+        view_off["egress"] = t_off["egress"]
+        view_env = {
+            k: t_env[k][:n]
+            for k in ("pod_ns_id", "pod_ip", "pod_ip_valid")
+        }
+        view_env["ingress"] = t_env["ingress"]
+        view_env["egress"] = t_env["egress"]
+        s_off = pod_signatures(view_off, sel_off, cidr=None)
+        s_env = pod_signatures(view_env, sel_off, cidr=None)
+        assert np.array_equal(s_off, s_env)
+        assert (
+            e_off.pod_classes().n_classes == e_env.pod_classes().n_classes
+        )
+
+    def test_auto_floor_and_force(self, monkeypatch):
+        monkeypatch.setenv("CYCLONUS_CIDR_TSS", "auto")
+        pods, namespaces = mk_cluster(self.IPS)
+        policy = build_network_policies(
+            True, [mk_np("np0", "x", self.BLOCKS)]
+        )
+        e_auto = TpuPolicyEngine(policy, pods, namespaces, class_compress="1")
+        assert e_auto._class_state.get("cidr") is None  # under the floor
+        monkeypatch.setenv("CYCLONUS_CIDR_TSS_MIN", "1")
+        e_low = TpuPolicyEngine(policy, pods, namespaces, class_compress="1")
+        assert e_low._class_state.get("cidr") is not None
+        assert not e_low.cidr_stats()["device"]  # small: numpy twin ran
+
+    def test_budget_fallback(self, monkeypatch):
+        """Partition tensors past CYCLONUS_SLAB_MAX_BYTES degrade to the
+        dense bit path (never over-commit), verdicts unchanged."""
+        monkeypatch.setenv("CYCLONUS_SLAB_MAX_BYTES", "64")
+        e, policy, pods, namespaces = build_engine(
+            self.BLOCKS, self.IPS, class_compress="1", cidr_tss="1"
+        )
+        assert e._class_state.get("cidr") is None
+        assert not e.cidr_stats()["active"]
+        monkeypatch.delenv("CYCLONUS_SLAB_MAX_BYTES")
+        e2, *_ = build_engine(
+            self.BLOCKS, self.IPS, class_compress="1", cidr_tss="1"
+        )
+        for name in ("ingress", "egress", "combined"):
+            assert np.array_equal(
+                np.asarray(getattr(e.evaluate_grid(CASES), name)),
+                np.asarray(getattr(e2.evaluate_grid(CASES), name)),
+            )
+
+    def test_aux_bytes_counts_partition_tensors(self):
+        e_tss, *_ = build_engine(
+            self.BLOCKS, self.IPS, class_compress="1", cidr_tss="1"
+        )
+        e_bit, *_ = build_engine(
+            self.BLOCKS, self.IPS, class_compress="1", cidr_tss="0"
+        )
+        space = e_tss._class_state["cidr"]
+        assert space is not None
+        assert space.nbytes() > 0
+        assert e_tss.cidr_stats()["bytes"] == space.nbytes()
+        # the TSS engine charges the partition tensors on top of its
+        # class tensors (class sets differ slightly, so compare against
+        # its OWN ctensors sum, not the bit engine's)
+        st = e_tss._class_state
+        from cyclonus_tpu.engine.api import _np_leaves
+
+        base = int(
+            e_tss.encoding.cluster.n_pods * 4
+            + st["ctensors"]["pod_ns_id"].shape[0] * 4
+            + sum(a.nbytes for a in _np_leaves(st["ctensors"]))
+        )
+        assert st["aux_bytes"] == base + space.nbytes()
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError):
+            cidrspace.tss_mode("bogus")
+
+
+class TestServeDeltas:
+    def _service(self, monkeypatch, blocks):
+        from cyclonus_tpu.serve import VerdictService
+
+        monkeypatch.setenv("CYCLONUS_CIDR_TSS", "1")
+        pods, namespaces = mk_cluster(
+            ["10.0.1.5", "10.0.1.6", "10.0.2.7", "10.0.3.8"]
+        )
+        policies = [mk_np("np0", "x", blocks)]
+        svc = VerdictService(
+            pods, namespaces, policies, class_compress="1"
+        )
+        assert svc.engine._class_state.get("cidr") is not None
+        return svc
+
+    def _upsert(self, blocks):
+        from cyclonus_tpu.kube.yaml_io import policy_to_dict
+        from cyclonus_tpu.worker.model import Delta
+
+        pol = mk_np("np0", "x", blocks)
+        return Delta(
+            kind="policy_upsert",
+            namespace="x",
+            name="np0",
+            policy=policy_to_dict(pol),
+        )
+
+    BASE_BLOCKS = [
+        IPBlock.make("10.0.0.0/8", ["10.0.9.0/24"]),
+        IPBlock.make("10.0.1.0/24", []),
+    ]
+
+    def test_same_structure_delta_stays_incremental(self, monkeypatch):
+        svc = self._service(monkeypatch, self.BASE_BLOCKS)
+        # swap one /24 for another: same mask structure (/8, /24), new
+        # atom — patchable; the class state rebuilds with a fresh map
+        report = svc.apply([
+            self._upsert([
+                IPBlock.make("10.0.0.0/8", ["10.0.9.0/24"]),
+                IPBlock.make("10.0.2.0/24", []),
+            ])
+        ])
+        assert report["mode"] in ("incremental", "class_rebuild")
+        svc.verify_parity(CASES)
+        space = svc.engine._class_state["cidr"]
+        assert space is not None
+        # the new /24 atom is in the refreshed map
+        assert int(space.n_atoms) == 3
+
+    def test_new_mask_structure_forces_full_rebuild(self, monkeypatch):
+        svc = self._service(monkeypatch, self.BASE_BLOCKS)
+        before = int(ti.SERVE_FALLBACKS.value(reason="ineligible"))
+        # a /28 appears: new partition -> signature layout change ->
+        # Ineligible -> full rebuild, never a patched-over stale map
+        report = svc.apply([
+            self._upsert([
+                IPBlock.make("10.0.0.0/8", ["10.0.9.0/24"]),
+                IPBlock.make("10.0.1.0/24", []),
+                IPBlock.make("10.0.1.16/28", []),
+            ])
+        ])
+        assert report["mode"] == "full"
+        assert int(ti.SERVE_FALLBACKS.value(reason="ineligible")) > before
+        svc.verify_parity(CASES)
+        space = svc.engine._class_state["cidr"]
+        assert space is not None and 28 in list(space.pprefix)
+
+    def test_empty_cluster_rebuild_survives(self, monkeypatch):
+        """Removing every pod under TSS-active class state must keep
+        rebuilding (n=0 signature matrix) — the zero-size reshape in
+        _ip_signature_tss regressed this once (review finding)."""
+        from cyclonus_tpu.worker.model import Delta
+
+        svc = self._service(monkeypatch, self.BASE_BLOCKS)
+        for key in list(svc.pods):
+            ns, name = key.split("/", 1)
+            svc.apply([Delta(kind="pod_remove", namespace=ns, name=name)])
+        assert not svc.pods
+        report = svc.apply([
+            Delta(kind="pod_add", namespace="x", name="fresh",
+                  labels={"app": "a0"}, ip="10.0.9.50")
+        ])
+        assert report["applied"] == 1
+        svc.verify_parity(CASES)
+
+    def test_pod_delta_uses_cached_map(self, monkeypatch):
+        svc = self._service(monkeypatch, self.BASE_BLOCKS)
+        from cyclonus_tpu.worker.model import Delta
+
+        report = svc.apply([
+            Delta(
+                kind="pod_add",  # existing key: an in-place pod update
+                namespace="x",
+                name="p0",
+                labels={"app": "a1"},
+                ip="10.0.9.77",  # moves INTO the except: membership flips
+            )
+        ])
+        assert report["mode"] in ("incremental", "class_rebuild")
+        svc.verify_parity(CASES)

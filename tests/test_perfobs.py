@@ -35,7 +35,7 @@ from cyclonus_tpu.perfobs import report as perf_report  # noqa: E402
 
 def healthy_line(
     value=100e9, warmup=5.0, encode=1.0, mesh_rows=None, virtual=True,
-    serve=None, tiers=None, pack=None, roofline=None,
+    serve=None, tiers=None, pack=None, roofline=None, cidr=None,
 ):
     detail = {
         "build_s": 0.5,
@@ -79,6 +79,8 @@ def healthy_line(
         detail["serve"] = serve
     if tiers is not None:
         detail["tiers"] = tiers
+    if cidr is not None:
+        detail["cidr"] = cidr
     if pack is not None:
         detail["pack"] = pack
     if roofline is not None:
@@ -716,6 +718,95 @@ class TestTiersFields:
         slow = healthy_line(value=120e9)
         base["detail"]["phase_history_s"].append(["tiers", 1.0])
         slow["detail"]["phase_history_s"].append(["tiers", 60.0])
+        led = self._ledger(
+            wrap(1, base), wrap(2, healthy_line()), wrap(3, slow),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+
+
+def cidr_detail(lpm_s=0.002, distinct=1024, partitions=7, active=True):
+    return {
+        "active": active,
+        "pods": 2048,
+        "distinct_cidrs": distinct,
+        "atoms": distinct + 12,
+        "partitions": partitions,
+        "classes": 96,
+        "ratio": 21.33,
+        "lpm_s": lpm_s,
+        "device": False,
+        "bytes": 16212,
+        "speedup_vs_dense": 12.5,
+        "parity_spot_checks": 6,
+    }
+
+
+class TestCidrFields:
+    """detail.cidr rides every BENCH line; the ledger parses
+    active/distinct/partitions/classes/ratio/lpm_s and the sentinel
+    treats lpm_s WARN-ONLY (the leg's own dense-vs-TSS throughput
+    assertion and oracle spot parity are the hard gates) — the same
+    posture class_compression_ratio took when it landed."""
+
+    def _ledger(self, *docs, tmp_path):
+        return load_ledger(write_rounds(tmp_path, list(docs)))
+
+    def test_ledger_parses_cidr_fields(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(cidr=cidr_detail())), tmp_path=tmp_path
+        )
+        run = led.runs[0]
+        assert run.cidr_active is True
+        assert run.cidr_distinct == 1024
+        assert run.cidr_partitions == 7
+        assert run.cidr_classes == 96
+        assert run.cidr_ratio == 21.33
+        assert run.cidr_lpm_s == 0.002
+        rt = PerfRun.from_dict(run.to_dict())
+        assert rt.cidr_lpm_s == run.cidr_lpm_s
+        assert rt.cidr_distinct == run.cidr_distinct
+
+    def test_old_artifacts_without_cidr_parse(self, tmp_path):
+        led = self._ledger(wrap(1, healthy_line()), tmp_path=tmp_path)
+        run = led.runs[0]
+        assert run.cidr_active is False
+        assert run.cidr_lpm_s is None
+        assert run.cidr_distinct is None
+
+    def test_cidr_degradation_warns_never_fails(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(cidr=cidr_detail(lpm_s=0.002))),
+            wrap(2, healthy_line(cidr=cidr_detail(lpm_s=0.003))),
+            wrap(3, healthy_line(value=120e9,
+                                 cidr=cidr_detail(lpm_s=0.02))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        report = result.report()
+        assert "cidr_lpm_s degraded" in report
+        assert "warn, not fail" in report
+
+    def test_cidr_within_tolerance_no_warning(self, tmp_path):
+        led = self._ledger(
+            wrap(1, healthy_line(cidr=cidr_detail(lpm_s=0.002))),
+            wrap(2, healthy_line(value=110e9,
+                                 cidr=cidr_detail(lpm_s=0.003))),
+            tmp_path=tmp_path,
+        )
+        result = gate(led)
+        assert result.status == "pass", result.report()
+        assert "cidr_lpm_s degraded" not in result.report()
+
+    def test_cidr_phase_not_generically_gated(self, tmp_path):
+        # a slow cidr phase must not trip the per-phase rule — the
+        # leg's knobs (BENCH_CIDR_*) legitimately vary per round
+        base = healthy_line()
+        slow = healthy_line(value=120e9)
+        base["detail"]["phase_history_s"].append(["cidr", 1.0])
+        slow["detail"]["phase_history_s"].append(["cidr", 60.0])
         led = self._ledger(
             wrap(1, base), wrap(2, healthy_line()), wrap(3, slow),
             tmp_path=tmp_path,
