@@ -22,9 +22,15 @@ test:
 # derived-cache invalidation, env-on-cached-path, persisted write
 # discipline, never-raise degradation contracts; docs/DESIGN.md "Cache
 # discipline"), runs over the cache-bearing packages.
-# tests/test_cachelint.py pins the four legs under a combined
+# The fifth leg, the dispatch-surface lint (tools/planlint.py —
+# route-recorder literals vs the PathSpec registry, differential-gate
+# existence, compatibility-matrix completeness, determinism hazards,
+# dead declarations; docs/DESIGN.md "Plan surface"), cross-checks
+# engine/planspec.py against the dispatch graph and emits the plan
+# manifest artifact.
+# tests/test_cachelint.py pins the five legs under a combined
 # one-minute wall-clock budget so the gate stays cheap enough to run.
-lint: shapelint cachelint
+lint: shapelint cachelint planlint
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
@@ -44,6 +50,17 @@ cachelint:
 	python tools/cachelint.py cyclonus_tpu/engine cyclonus_tpu/serve \
 	  cyclonus_tpu/perfobs cyclonus_tpu/chaos
 
+planlint:
+	python tools/planlint.py --manifest artifacts/plan_manifest.json \
+	  cyclonus_tpu/engine cyclonus_tpu/serve cyclonus_tpu/tiers
+
+# git-diff-scoped lint: run only the legs whose scanned paths contain a
+# file changed vs the merge base (falls back to HEAD for a clean tree).
+# Registry-level legs (planlint) always run in full — their findings
+# are cross-file by construction.
+lint-changed:
+	python tools/lint_changed.py
+
 # the key-mutation harness (tests/keyharness.py; docs/DESIGN.md "Cache
 # discipline"): for every registered cache family, perturb each key
 # component one at a time and assert a miss/retrace, then revert and
@@ -53,6 +70,16 @@ cachelint:
 # runs in tier-1 via tests/test_cachelint.py; this is the full sweep.
 keyharness:
 	JAX_PLATFORMS=cpu python -m tests.keyharness --full --verbose
+
+# the dispatch-route harness (tests/planharness.py; docs/DESIGN.md
+# "Plan surface"): arm the route recorder (CYCLONUS_PLANHARNESS=1),
+# sweep the governing flag/argument matrix through the real public
+# entry points, and assert the recorded routes equal what the PathSpec
+# registry predicts — including the compatibility matrix's exact raise
+# messages.  The quick slice runs in tier-1 via tests/test_planlint.py;
+# this is the full sweep (adds the slow ring-pipeline leg).
+planharness:
+	JAX_PLATFORMS=cpu python -m tests.planharness --full --verbose
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -166,4 +193,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint shapelint cachelint keyharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint lint-changed shapelint cachelint planlint keyharness planharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker

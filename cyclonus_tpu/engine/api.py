@@ -21,7 +21,7 @@ from ..matcher.core import Policy
 from ..telemetry import instruments as ti
 from ..utils import guards
 from ..utils.tracing import phase
-from . import aot_cache
+from . import aot_cache, planspec
 from .encoding import (
     PEER_IP,
     PolicyEncoding,
@@ -1277,11 +1277,9 @@ class TpuPolicyEngine:
         st = self._class_state
         if st is None:
             return False
-        import os
+        from ..utils import envflags
 
-        budget = int(
-            os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30))
-        )
+        budget = envflags.get_int("CYCLONUS_SLAB_MAX_BYTES")
         ct = st["ctensors"]
         cb = int(ct["pod_ns_id"].shape[0])
         t = sum(
@@ -1319,6 +1317,7 @@ class TpuPolicyEngine:
         st = self._class_state
         pc = st["classes"]
         if sharded:
+            planspec.record("counts.sharded.classes")
             from .tiled import evaluate_grid_counts_classes_sharded
 
             counts, gather_s = evaluate_grid_counts_classes_sharded(
@@ -1330,6 +1329,7 @@ class TpuPolicyEngine:
                 mesh=mesh,
             )
         else:
+            planspec.record("counts.classes")
             from .tiled import evaluate_grid_counts_classes
 
             counts, gather_s = evaluate_grid_counts_classes(
@@ -1353,6 +1353,7 @@ class TpuPolicyEngine:
 
         from .kernel import evaluate_grid_kernel, gather_class_grids
 
+        planspec.record("grid.classes")
         st = self._class_state
         n = self.encoding.cluster.n_pods
         with ti.eval_flight(
@@ -1404,6 +1405,7 @@ class TpuPolicyEngine:
 
         from .sharded import evaluate_class_grid_sharded
 
+        planspec.record("grid.sharded.classes")
         st = self._class_state
         pc = st["classes"]
         tensors = self._ctensors_with_cases(cases)
@@ -1492,6 +1494,7 @@ class TpuPolicyEngine:
             return GridVerdict(self.pod_keys, [], empty, empty.copy(), empty.copy())
         if self._class_state is not None:
             return self._evaluate_grid_classes(cases)
+        planspec.record("grid.dense")
         n = self.encoding.cluster.n_pods
         if self._grid_aot is None:
             self._grid_aot = aot_cache.AotProgram(
@@ -1588,27 +1591,19 @@ class TpuPolicyEngine:
                 f"unknown counts backend {backend!r} (want 'xla' or "
                 f"'pallas'; mesh-parallel = evaluate_grid_counts_sharded)"
             )
-        if self.tiers is not None and backend == "pallas":
-            # the DENSE pallas counts kernel keeps the networkingv1-only
-            # fast path (its OR-reduction precompute cannot express the
-            # first-match lattice).  Under the PACKED dtype plan the
-            # packed kernel fuses the tier min-key epilogue, so tiered
-            # counts ride pallas directly — unless the rule-row count
-            # exceeds the static-unroll ceiling.  Otherwise tiered
-            # counts run the XLA tile loop: the auto default routes
-            # silently, an EXPLICIT pallas request fails loudly —
-            # silently rewriting it would let a benchmark publish the
-            # XLA rate under the pallas label
-            if not (self._pack and self._packed_tier_ok()):
-                if explicit:
-                    raise ValueError(
-                        "counts backend 'pallas' cannot evaluate the "
-                        "precedence-tier lattice on this engine "
-                        "(packed plan off or tier rows past the fused-"
-                        "epilogue ceiling); use backend='xla' or "
-                        "backend=None (auto)"
-                    )
-                backend = "xla"
+        # tiers x pallas: the decision (legal under the packed fused
+        # tier epilogue; else fallback on auto, loud failure on an
+        # explicit request — silently rewriting it would let a benchmark
+        # publish the XLA rate under the pallas label) is a declared
+        # cell of the planspec compatibility matrix, resolved there so
+        # the declaration and the dispatch cannot drift
+        backend = planspec.resolve_counts_backend(
+            backend=backend,
+            explicit=explicit,
+            tiers=self.tiers is not None,
+            pack=self._pack,
+            packed_tier_ok=self._packed_tier_ok,
+        )
         self._check_ips()
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
@@ -1623,6 +1618,7 @@ class TpuPolicyEngine:
             return self._counts_classes(cases, n)
         if backend == "pallas":
             return self._counts_pallas_packed(cases, n)
+        planspec.record("counts.xla")
         from .tiled import evaluate_grid_counts
 
         # the xla path pads the pod axis with numpy before dispatch
@@ -1729,7 +1725,9 @@ class TpuPolicyEngine:
         # CYCLONUS_SLAB_MAX_BYTES
         itemsize = 2 if _resolve_operand_dtype(None) == "bf16" else 1
         bytes_per_case = n_tiles * slab_w_aug() * n_b * itemsize
-        budget = int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
+        from ..utils import envflags
+
+        budget = envflags.get_int("CYCLONUS_SLAB_MAX_BYTES")
         # the class-compression gather/index tensors share the budget:
         # without counting them here the slab + aux could jointly
         # over-commit HBM exactly when compression is supposed to save it
@@ -1968,12 +1966,12 @@ class TpuPolicyEngine:
         # milliseconds, so the cancel flag rarely interrupts the loop) —
         # the orphan gate (_drain_autotune_orphan) bounds and counts any
         # overlap with the caller's subsequent default-path work.
-        import os
         import threading
 
+        from ..utils import envflags
         from ..utils.bounded import run_bounded
 
-        timeout_s = float(os.environ.get("CYCLONUS_AUTOTUNE_TIMEOUT_S", "240"))
+        timeout_s = envflags.get_float("CYCLONUS_AUTOTUNE_TIMEOUT_S")
         candidate_done = threading.Event()
 
         def candidate():
@@ -2158,9 +2156,9 @@ class TpuPolicyEngine:
 
         ti.AUTOTUNE_SEARCHES.inc()
         t_search0 = _time.perf_counter()
-        timeout_s = float(
-            os.environ.get("CYCLONUS_AUTOTUNE_TIMEOUT_S", "240")
-        )
+        from ..utils import envflags
+
+        timeout_s = envflags.get_float("CYCLONUS_AUTOTUNE_TIMEOUT_S")
         results = []  # (bs, bd, best_s, rounds, out) for candidates that ran
         stats = []
         base_rounds = None
@@ -2408,7 +2406,9 @@ class TpuPolicyEngine:
         transfer: unpack + pod-axis ns-sort + precompute + pallas counts
         all trace into one jit, so a cold process pays one host->device
         transfer (shared with the grid/pairs paths), one trace, one
-        (persistently cached) compile, and one execution.
+        (persistently cached) compile, and one execution.  Records as
+        planspec path "counts.pallas"; the steady-state kernel choice
+        within it records its own counts.steady.* leaf.
 
         Why the sort: a target applies to pods of exactly one namespace,
         so with pods ns-sorted (on device, via the permutation gather
@@ -2424,6 +2424,7 @@ class TpuPolicyEngine:
 
         from .sharded import _POD_KEYS
 
+        planspec.record("counts.pallas")
         buf = self._ensure_packed()
         if self._pod_perm_dev is None:
             # bucketing pads carry ns id -1: keep them LAST (the kernel's
@@ -2643,6 +2644,7 @@ class TpuPolicyEngine:
         precompute (which under the packed plan is the packed kernel at
         the default tile).  Returns the async partials array."""
         if slab_args[0] is not None:
+            planspec.record("counts.steady.slab")
             return self._counts_from_slab_ops_jit(self._slab_ops_for(key))
         n32 = np.int32(self.encoding.cluster.n_pods)
         if (
@@ -2650,9 +2652,11 @@ class TpuPolicyEngine:
             and choice.get("kernel") == "packed"
             and "bs" in choice
         ):
+            planspec.record("counts.steady.packed_tuned")
             return self._counts_from_pre_packed_jit(
                 self._pre_cache[1], n32, bs=choice["bs"], bd=choice["bd"]
             )
+        planspec.record("counts.steady.default")
         return self._counts_from_pre_jit(self._pre_cache[1], n32, None, None)
 
     def counts_pipelined_eval_s(
@@ -2733,18 +2737,13 @@ class TpuPolicyEngine:
             )
         from .tiled import evaluate_grid_counts_sharded
 
-        if self.tiers is not None and kernel != "xla":
-            # per-device pallas keeps the networkingv1 fast path; the
-            # XLA tile body carries the tier resolution epilogue.  Same
-            # rule as evaluate_grid_counts: auto routes, an explicit
-            # pallas request fails loudly
-            if kernel is not None:
-                raise ValueError(
-                    f"sharded counts kernel {kernel!r} cannot evaluate "
-                    "the precedence-tier lattice; use kernel='xla' or "
-                    "kernel=None (auto) on a tiered engine"
-                )
-            kernel = "xla"
+        # tiers x per-device pallas: same matrix cell discipline as
+        # evaluate_grid_counts — auto routes to the XLA tile body (it
+        # carries the tier resolution epilogue), an explicit pallas
+        # request fails loudly with the declared message
+        kernel = planspec.resolve_sharded_counts_kernel(
+            kernel=kernel, tiers=self.tiers is not None
+        )
         return evaluate_grid_counts_sharded(
             self._tensors_with_cases(cases), n, block=block, mesh=mesh,
             kernel=kernel,
@@ -2761,6 +2760,7 @@ class TpuPolicyEngine:
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        planspec.record("counts.ring")
         from .tiled import evaluate_grid_counts_ring
 
         return evaluate_grid_counts_ring(
@@ -2786,6 +2786,7 @@ class TpuPolicyEngine:
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return None
+        planspec.record("counts.ring.pipelined")
         from .tiled import evaluate_grid_counts_ring_pipelined
 
         return evaluate_grid_counts_ring_pipelined(
@@ -2804,6 +2805,7 @@ class TpuPolicyEngine:
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return {"ingress": 0, "egress": 0, "combined": 0, "cells": 0}
+        planspec.record("counts.ring2d")
         from .tiled import evaluate_grid_counts_ring2d
 
         return evaluate_grid_counts_ring2d(
@@ -2821,6 +2823,7 @@ class TpuPolicyEngine:
         n = self.encoding.cluster.n_pods
         if not cases or n == 0:
             return iter(())
+        planspec.record("grid.blocks")
         return iter_grid_blocks(self._tensors_with_cases(cases), n, block=block)
 
     def evaluate_pairs(
@@ -2835,6 +2838,7 @@ class TpuPolicyEngine:
         self._check_ips()
         if not cases or len(pairs) == 0:
             return np.zeros((len(pairs), len(cases), 3), dtype=bool)
+        planspec.record("pairs.aot")
         idx = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
         if self._pairs_aot is None:
             # the serve query path's program: a restarted serve replica
@@ -2873,6 +2877,7 @@ class TpuPolicyEngine:
         from .kernel import rule_firing_kernel
 
         self._check_ips()
+        planspec.record("firing.raw")
         raw = self._build_tensors()
         q_port, q_name, q_proto = self._port_case_arrays(cases)
         # "tiers" excluded on purpose: firing masks are a NetworkPolicy-
@@ -2906,7 +2911,7 @@ class TpuPolicyEngine:
         "allgather" (the replicated reference) — bit-identical grids
         either way.  A 1-device mesh still runs the sharded program;
         use evaluate_grid for the plain single-device kernel."""
-        from .sharded import evaluate_grid_sharded
+        from .sharded import evaluate_grid_sharded, mesh_schedule
 
         self._check_ips()
         if not cases:
@@ -2915,6 +2920,12 @@ class TpuPolicyEngine:
             return self._evaluate_grid_sharded_classes(
                 cases, mesh, schedule=schedule
             )
+        # record at the dispatch leaf, not inside the shared shard_map
+        # primitive (the compressed route reuses it over the class axis)
+        if mesh_schedule(schedule) == "ring":
+            planspec.record("grid.sharded.ring")
+        else:
+            planspec.record("grid.sharded.allgather")
         tensors = self._tensors_with_cases(cases)
         import jax.numpy as jnp
 

@@ -322,8 +322,6 @@ def resolve(
     IPv4 atoms, unprofitable (auto below the distinct-spec floor), or
     over the HBM budget (partition tensors + the staged [K, N]
     signature vs CYCLONUS_SLAB_MAX_BYTES)."""
-    import os
-
     m = tss_mode(mode)
     if m == "0":
         return None
@@ -334,12 +332,9 @@ def resolve(
         return None
     if n_pods is None:
         n_pods = int(tensors["pod_ip"].shape[0]) if "pod_ip" in tensors else 0
-    try:
-        budget = int(
-            os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30))
-        )
-    except ValueError:
-        budget = 6 * 2**30
+    from ..utils import envflags
+
+    budget = envflags.get_int("CYCLONUS_SLAB_MAX_BYTES")
     staged = space.nbytes() + 4 * space.n_partitions * n_pods + 4 * n_pods
     if staged > budget:
         return None

@@ -1232,6 +1232,12 @@ def evaluate_grid_counts_sharded(
         raise ValueError(
             f"unknown sharded counts kernel {kernel!r} (want 'pallas' or 'xla')"
         )
+    from . import planspec
+
+    if kernel == "pallas":
+        planspec.record("counts.sharded.pallas")
+    else:
+        planspec.record("counts.sharded.xla")
     mesh, n_dev, q, block, tensors, n_padded = _mesh_counts_setup(
         tensors, n_pods, block, mesh
     )

@@ -114,16 +114,14 @@ def pow2_pad(n: int) -> int:
 
 def patch_byte_budget() -> int:  # never-raises
     """CYCLONUS_SLAB_MAX_BYTES as the staged-patch ceiling (default
-    6 GiB) — the one parse every patch path (pod/ns rows in service.py,
-    rule slabs in patch_policy) shares, so a malformed value degrades
-    to the default everywhere instead of raising on one path only."""
-    import os
+    6 GiB) — parsed through the utils/envflags registry, the one parse
+    every consumer (pod/ns rows in service.py, rule slabs in
+    patch_policy, engine counts slabs, CIDR staging) now shares, so a
+    malformed value degrades to the default everywhere instead of
+    raising on one path only."""
+    from ..utils import envflags
 
-    try:
-        return int(os.environ.get("CYCLONUS_SLAB_MAX_BYTES", str(6 * 2**30)))
-    except Exception as e:
-        logger.debug("malformed CYCLONUS_SLAB_MAX_BYTES: %s", e)
-        return 6 * 2**30
+    return envflags.get_int("CYCLONUS_SLAB_MAX_BYTES")
 
 
 def _scatter_words(buf, idx: np.ndarray, vals: np.ndarray):

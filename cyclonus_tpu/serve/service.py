@@ -759,8 +759,12 @@ class VerdictService:
         state fallback instead — exact verdicts at host speed, counted
         in cyclonus_tpu_serve_degraded_queries_total — so a fleet
         router that ignores /readyz still gets correct answers."""
+        from ..engine import planspec
+
         if not self._ready.is_set():
+            planspec.record("serve.query.degraded")
             return self._query_degraded(queries)
+        planspec.record("serve.query.live")
         t0 = time.perf_counter()
         with self._lock:
             # host-side span only (serve.query): no device sync inside

@@ -1,0 +1,257 @@
+"""The CYCLONUS_* environment vocabulary as a single declarative
+registry: every flag's name, type, parsed default, owning subsystem,
+and one-line meaning, plus never-raise accessors that parse through
+the registry.
+
+Two drifts motivated centralizing this:
+
+  * CYCLONUS_SLAB_MAX_BYTES had four parse sites; serve/incremental.py
+    and engine/cidrspace.py degraded a malformed value to the 6 GiB
+    default while engine/api.py's two sites parsed with a bare int()
+    and raised at evaluate time.  One flag, two failure modes.
+  * CYCLONUS_AUTOTUNE_TIMEOUT_S was parsed independently at both
+    autotune search sites in engine/api.py — same default today, but
+    nothing pinned them together.
+
+Accessors here never raise on a malformed value: they degrade to the
+registered default (the serve/incremental.py discipline, now uniform).
+Flags whose resolvers validate-and-raise on purpose (CYCLONUS_PACK,
+CYCLONUS_MESH_SCHEDULE, CYCLONUS_PALLAS_DTYPE reject unknown modes at
+entry-point resolution) keep their validating parse at the resolver;
+the registry still declares them so the vocabulary — and the README
+table generated from it — is complete.  tests/test_envflags.py greps
+the tree and fails on any CYCLONUS_* token missing from this registry.
+
+Bool semantics are encoded by the default: default False means the
+flag is opt-in (`== "1"`), default True means opt-out (`!= "0"`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    kind: str  # "bool" | "int" | "float" | "enum" | "str" | "path"
+    default: object
+    owner: str  # "engine" | "serve" | "worker" | "chaos" | "telemetry" | "probe" | "harness" | "cli"
+    description: str
+    choices: Tuple[str, ...] = field(default=())
+
+
+_FLAGS = [
+    # --- engine: evaluation plans and budgets -------------------------
+    Flag("CYCLONUS_SLAB_MAX_BYTES", "int", 6 * 2**30, "engine",
+         "HBM byte budget shared by counts slabs, CIDR staging, and "
+         "serve's staged patches (default 6 GiB)."),
+    Flag("CYCLONUS_PACK", "enum", "auto", "engine",
+         "Packed dtype plan kill switch; resolved eagerly at entry "
+         "points (encoding.resolve_pack).", choices=("auto", "0", "1")),
+    Flag("CYCLONUS_COMPACT", "enum", "", "engine",
+         "Rule-compaction opt-out: '0' disables, '1' forces past the "
+         "host-work budget, '' (default) auto.", choices=("", "0", "1")),
+    Flag("CYCLONUS_PRE_CACHE", "bool", True, "engine",
+         "Pre-classification cache of selector->pod matches."),
+    Flag("CYCLONUS_CLASS_COMPRESS", "enum", "auto", "engine",
+         "Pod-class compression: 'auto' (size floor), '1' (force), "
+         "'0' (off).", choices=("auto", "0", "1")),
+    Flag("CYCLONUS_CLASS_MIN_PODS", "int", 4096, "engine",
+         "Pod-count floor below which auto class compression stays "
+         "off."),
+    Flag("CYCLONUS_FULL_LOCATIONS", "bool", False, "engine",
+         "Keep full jaxpr source locations (debug; bigger traces)."),
+    Flag("CYCLONUS_JAX_CACHE", "path", "", "engine",
+         "JAX persistent compilation cache dir; '0' disables, '' picks "
+         "the default dir."),
+    # --- engine: kernels and autotune ---------------------------------
+    Flag("CYCLONUS_PALLAS_DTYPE", "enum", "int8", "engine",
+         "Pallas counts-kernel operand dtype.",
+         choices=("int8", "bf16")),
+    Flag("CYCLONUS_PALLAS_SLAB", "enum", "auto", "engine",
+         "Pallas slab materialization: 'auto' (TPU only), '1', '0'.",
+         choices=("auto", "0", "1")),
+    Flag("CYCLONUS_MESH_SCHEDULE", "enum", "ring", "engine",
+         "Sharded counts schedule (sharded.mesh_schedule).",
+         choices=("ring", "allgather", "ring2d", "ring-pipelined")),
+    Flag("CYCLONUS_AUTOTUNE", "enum", "auto", "engine",
+         "Steady-state kernel autotune: 'auto' (TPU only), '1' "
+         "(force, interpret ok), '0' (off).",
+         choices=("auto", "0", "1")),
+    Flag("CYCLONUS_AUTOTUNE_REPS", "int", 4, "engine",
+         "Timed reps per autotune round."),
+    Flag("CYCLONUS_AUTOTUNE_ROUNDS", "int", 3, "engine",
+         "Autotune rounds per candidate."),
+    Flag("CYCLONUS_AUTOTUNE_TIMEOUT_S", "float", 240.0, "engine",
+         "Wall-clock bound on one autotune search (both the packed "
+         "candidate search and the dense search share it)."),
+    Flag("CYCLONUS_AUTOTUNE_DRAIN_S", "float", 5.0, "engine",
+         "Grace period for an orphaned autotune candidate thread."),
+    Flag("CYCLONUS_AUTOTUNE_CACHE", "path", "", "engine",
+         "Autotune result cache file; '0' disables, '' picks the "
+         "default path."),
+    Flag("CYCLONUS_AOT_CACHE", "path", "", "engine",
+         "Persistent AOT executable cache dir; '0' disables, '' picks "
+         "the default dir."),
+    # --- engine: CIDR pre-classification ------------------------------
+    Flag("CYCLONUS_CIDR_TSS", "enum", "auto", "engine",
+         "TSS/LPM CIDR pre-classification: 'auto' (spec floor), '1', "
+         "'0'.", choices=("auto", "0", "1")),
+    Flag("CYCLONUS_CIDR_TSS_MIN", "int", 256, "engine",
+         "CIDR spec-count floor for auto TSS."),
+    Flag("CYCLONUS_CIDR_TSS_DEVICE", "enum", "auto", "engine",
+         "Device-side TSS classify: 'auto' (cell floor), '1', '0'.",
+         choices=("auto", "0", "1")),
+    Flag("CYCLONUS_CIDR_DEVICE_MIN", "int", 1 << 24, "engine",
+         "Cell-count floor for auto device-side TSS classify."),
+    # --- serve ---------------------------------------------------------
+    Flag("CYCLONUS_SERVE_HEADROOM", "int", 1, "serve",
+         "Spare compiled-shape buckets kept warm past the live "
+         "snapshot's need."),
+    Flag("CYCLONUS_SERVE_PREWARM", "bool", True, "serve",
+         "Prewarm compiled programs at serve start."),
+    Flag("CYCLONUS_SERVE_PREWARM_PAIRS", "int", 64, "serve",
+         "Pair-batch bucket size prewarmed for query()."),
+    Flag("CYCLONUS_SERVE_CHURN_ROWS", "int", 64, "serve",
+         "Row-growth slack per incremental patch flush."),
+    Flag("CYCLONUS_SERVE_CHURN_FRAC", "float", 0.25, "serve",
+         "Fraction of snapshot rows tolerated as staged churn before "
+         "rebuild."),
+    # --- worker / fleet -------------------------------------------------
+    Flag("CYCLONUS_WORKER_TIMEOUT_S", "float", 120.0, "worker",
+         "Per-request worker RPC timeout."),
+    Flag("CYCLONUS_WORKER_RETRIES", "int", 2, "worker",
+         "Worker RPC retry attempts."),
+    Flag("CYCLONUS_WORKER_BACKOFF_S", "float", 0.5, "worker",
+         "Base backoff between worker RPC retries."),
+    Flag("CYCLONUS_WORKER_IMAGE", "str", "cyclonus-tpu-worker:latest",
+         "worker", "Worker container image."),
+    Flag("CYCLONUS_AGNHOST_IMAGE", "str", "", "worker",
+         "Agnhost probe image override."),
+    Flag("CYCLONUS_CONNECT_NATIVE", "bool", False, "worker",
+         "Probe with native sockets instead of agnhost exec."),
+    Flag("CYCLONUS_SOURCE_IP", "str", "", "worker",
+         "Source IP override for native probes."),
+    # --- probe ----------------------------------------------------------
+    Flag("CYCLONUS_BACKEND_TIMEOUT_S", "float", 75.0, "probe",
+         "Probe-backend request timeout."),
+    # --- chaos ----------------------------------------------------------
+    Flag("CYCLONUS_CHAOS", "str", "", "chaos",
+         "Fault-injection spec armed for the chaos harness."),
+    Flag("CYCLONUS_CHAOS_TTFV_S", "float", 150.0, "chaos",
+         "Time-to-first-verdict bound asserted by the chaos harness."),
+    # --- telemetry ------------------------------------------------------
+    Flag("CYCLONUS_TELEMETRY", "bool", True, "telemetry",
+         "Telemetry counters/gauges master switch."),
+    Flag("CYCLONUS_TRACE_EVENTS", "bool", False, "telemetry",
+         "Structured event trace emission."),
+    Flag("CYCLONUS_TRACE_EVENTS_N", "int", 8192, "telemetry",
+         "Event trace ring capacity."),
+    Flag("CYCLONUS_TRACE_ID", "str", "", "telemetry",
+         "Trace correlation id attached to emitted events."),
+    Flag("CYCLONUS_TRACE_VERDICTS", "bool", False, "telemetry",
+         "Per-verdict trace logging in the probe runner."),
+    Flag("CYCLONUS_FLIGHT_RECORDER_PATH", "path", "", "telemetry",
+         "Flight-recorder dump path ('' picks the default)."),
+    Flag("CYCLONUS_FLIGHT_RECORDER_N", "int", 64, "telemetry",
+         "Flight-recorder ring capacity."),
+    # --- harnesses (strip contracts: read ONCE at import) ---------------
+    Flag("CYCLONUS_SHAPE_CHECK", "bool", False, "harness",
+         "Arm runtime shape-contract checks (utils/contracts.py)."),
+    Flag("CYCLONUS_GUARD_CHECK", "bool", False, "harness",
+         "Arm runtime lock-guard checks (utils/guards.py)."),
+    Flag("CYCLONUS_KEYHARNESS", "bool", False, "harness",
+         "Arm the cache-key mutation recorder (utils/cachekeys.py)."),
+    Flag("CYCLONUS_PLANHARNESS", "bool", False, "harness",
+         "Arm the dispatch-route recorder (engine/planspec.py)."),
+]
+
+REGISTRY: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The unparsed environment value, or None when unset.  `name` must
+    be registered — an unregistered read is a programming error and
+    raises KeyError (at import/test time, not in degraded parsing)."""
+    flag = REGISTRY[name]
+    return os.environ.get(flag.name)
+
+
+def get_int(name: str) -> int:  # never-raises (registered names)
+    flag = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return int(flag.default)
+    try:
+        return int(raw)
+    except (ValueError, TypeError):
+        return int(flag.default)
+
+
+def get_float(name: str) -> float:  # never-raises (registered names)
+    flag = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return float(flag.default)
+    try:
+        return float(raw)
+    except (ValueError, TypeError):
+        return float(flag.default)
+
+
+def get_bool(name: str) -> bool:  # never-raises (registered names)
+    """Default-False flags are opt-in (== '1'); default-True flags are
+    opt-out (!= '0') — the two bool conventions the tree already uses,
+    selected by the registered default."""
+    flag = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(flag.default)
+    return raw != "0" if flag.default else raw == "1"
+
+
+def get_enum(name: str) -> str:  # never-raises (registered names)
+    """Lower-cased value, degrading to the registered default when the
+    value is not a registered choice.  Resolvers that must REJECT an
+    unknown mode (resolve_pack, mesh_schedule) keep their own
+    validating parse; this accessor is for callers that want the
+    degrade-to-default discipline."""
+    flag = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return str(flag.default)
+    val = raw.lower()
+    return val if val in flag.choices else str(flag.default)
+
+
+def get_str(name: str) -> str:  # never-raises (registered names)
+    flag = REGISTRY[name]
+    raw = os.environ.get(name)
+    return str(flag.default) if raw is None else raw
+
+
+def _render_default(flag: Flag) -> str:
+    if flag.kind == "bool":
+        return "on" if flag.default else "off"
+    if flag.name == "CYCLONUS_SLAB_MAX_BYTES":
+        return "6 GiB"
+    if flag.default == "":
+        return "(unset)"
+    return str(flag.default)
+
+
+def markdown_table(owner: Optional[str] = None) -> str:
+    """The README env-var table, generated so it cannot drift from the
+    registry (tests/test_envflags.py diffs README against this)."""
+    rows = [f for f in _FLAGS if owner is None or f.owner == owner]
+    out = ["| Variable | Type | Default | Subsystem | Meaning |",
+           "| --- | --- | --- | --- | --- |"]
+    for f in rows:
+        out.append(
+            f"| `{f.name}` | {f.kind} | {_render_default(f)} | "
+            f"{f.owner} | {f.description} |"
+        )
+    return "\n".join(out)

@@ -854,17 +854,19 @@ class TestKeyharnessFull:
 
 
 class TestLintBudget:
-    def test_four_legs_stay_under_wall_clock_budget(self):
+    def test_five_legs_stay_under_wall_clock_budget(self):
         """The combined `make lint` static legs (jaxlint + locklint +
-        shapelint + cachelint, in-process over their Makefile paths)
-        must stay inside one minute — the four-leg lint is part of
-        `make check`'s inner loop and a slow linter stops being run."""
+        shapelint + cachelint + planlint, in-process over their
+        Makefile paths) must stay inside one minute — the five-leg lint
+        is part of `make check`'s inner loop and a slow linter stops
+        being run."""
         import importlib
 
         t0 = time.perf_counter()
         jaxlint = importlib.import_module("jaxlint")
         locklint = importlib.import_module("locklint")
         shapelint = importlib.import_module("shapelint")
+        planlint = importlib.import_module("planlint")
         jax_paths = [
             os.path.join(REPO, "cyclonus_tpu", p)
             for p in (
@@ -885,5 +887,11 @@ class TestLintBudget:
             ]
         )
         cachelint.lint_paths(CACHE_PACKAGES)
+        planlint.lint_paths(
+            [
+                os.path.join(REPO, "cyclonus_tpu", p)
+                for p in ("engine", "serve", "tiers")
+            ]
+        )
         elapsed = time.perf_counter() - t0
-        assert elapsed < 60.0, f"four lint legs took {elapsed:.1f}s"
+        assert elapsed < 60.0, f"five lint legs took {elapsed:.1f}s"

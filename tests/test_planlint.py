@@ -1,0 +1,394 @@
+"""tools/planlint.py tests: seeded-violation gates for PL001–PL005
+(each defect class must fire, each suppression must be honored), the
+clean-run + declaration-count acceptance gate over the dispatch
+packages, the static-vs-runtime manifest identity (the AST-extracted
+registry must equal planspec.manifest() byte for byte), the plan
+manifest schema, predict()'s route/raise semantics, and the tier-1
+slice of the dispatch-route harness (tests/planharness.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import planlint
+
+DISPATCH_PACKAGES = [
+    os.path.join(REPO, "cyclonus_tpu", p)
+    for p in ("engine", "serve", "tiers")
+]
+
+GOOD_REGISTRY = """
+PATHS = (
+    PathSpec(name="a.path", entry="counts", gate="tests/test_ok.py"),
+    PathSpec(name="b.path", entry="counts", gate="tests/test_ok.py"),
+)
+INTERACTIONS = (
+    Interaction("tiers", "backend=pallas", "fallback"),
+)
+"""
+
+
+def _mini_repo(tmp_path, registry_src=GOOD_REGISTRY, module_src="",
+               tests=("test_ok.py",), makefile=None):
+    """A scratch repo tree: cyclonus_tpu/engine/{planspec,api}.py plus
+    the tests/ gate files planlint's PL002 resolves against."""
+    eng = tmp_path / "cyclonus_tpu" / "engine"
+    eng.mkdir(parents=True)
+    (tmp_path / "tests").mkdir()
+    for t in tests:
+        (tmp_path / "tests" / t).write_text("")
+    if makefile is not None:
+        (tmp_path / "Makefile").write_text(makefile)
+    (eng / "planspec.py").write_text(textwrap.dedent(registry_src))
+    (eng / "api.py").write_text(textwrap.dedent(module_src))
+    return str(eng)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+RECORD_BOTH = """
+from . import planspec
+def f():
+    planspec.record("a.path")
+    planspec.record("b.path")
+"""
+
+
+class TestPL001RouteLiterals:
+    def test_undeclared_literal_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+def g():
+    planspec.record("c.bogus")
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL001"]
+        assert "'c.bogus'" in findings[0].message
+
+    def test_dynamic_argument_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+def g(name):
+    planspec.record(name)
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL001"]
+        assert "not a string literal" in findings[0].message
+
+    def test_declared_literals_clean(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH)
+        findings, stats = planlint.lint_paths([eng])
+        assert findings == []
+        assert stats["records"] == 2
+
+    def test_suppression_honored(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+def g():
+    planspec.record("c.bogus")  # planlint: ignore[PL001]
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert findings == []
+
+
+class TestPL002DifferentialGates:
+    def test_missing_gate_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, registry_src="""
+PATHS = (PathSpec(name="a.path", entry="counts"),)
+""", module_src="""
+from . import planspec
+def f():
+    planspec.record("a.path")
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL002"]
+        assert "no differential gate" in findings[0].message
+
+    def test_dangling_test_file_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, registry_src="""
+PATHS = (
+    PathSpec(name="a.path", entry="counts", gate="tests/test_missing.py"),
+)
+""", module_src="""
+from . import planspec
+def f():
+    planspec.record("a.path")
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL002"]
+        assert "test_missing.py" in findings[0].message
+
+    def test_make_target_gate_resolves(self, tmp_path):
+        eng = _mini_repo(tmp_path, registry_src="""
+PATHS = (
+    PathSpec(name="a.path", entry="counts", gate="make planharness"),
+    PathSpec(name="b.path", entry="counts", gate="make nosuch"),
+)
+""", module_src=RECORD_BOTH,
+            makefile="planharness:\n\techo ok\n")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL002"]
+        assert "'make nosuch'" in findings[0].message
+
+
+class TestPL003CompatibilityMatrix:
+    def test_resolver_without_cell_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, registry_src="""
+PATHS = (
+    PathSpec(name="a.path", entry="counts", gate="tests/test_ok.py"),
+    PathSpec(name="b.path", entry="counts", gate="tests/test_ok.py"),
+)
+INTERACTIONS = ()
+""", module_src=RECORD_BOTH + """
+def g(backend):
+    return planspec.resolve_counts_backend(
+        backend=backend, explicit=True, tiers=True, pack=False,
+        packed_tier_ok=lambda: False,
+    )
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL003"]
+        assert "backend=pallas" in findings[0].message
+
+    def test_feature_pair_without_cell_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+def g(self, backend):
+    if self.tiers is not None and backend == "pallas":
+        return 1
+""")
+        # the mini registry declares (tiers, backend=pallas) — drop it
+        eng2 = _mini_repo(
+            tmp_path / "bare",
+            registry_src="""
+PATHS = (
+    PathSpec(name="a.path", entry="counts", gate="tests/test_ok.py"),
+    PathSpec(name="b.path", entry="counts", gate="tests/test_ok.py"),
+)
+INTERACTIONS = ()
+""",
+            module_src=RECORD_BOTH + """
+def g(self, backend):
+    if self.tiers is not None and backend == "pallas":
+        return 1
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert findings == []  # declared cell: clean
+        findings2, _ = planlint.lint_paths([eng2])
+        assert _codes(findings2) == ["PL003"]
+        assert "'backend=pallas' x 'tiers'" in findings2[0].message
+
+
+class TestPL004DeterminismHazards:
+    def test_set_iteration_feeding_tensor_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+import numpy as np
+def build(keys):
+    rows = []
+    for k in set(keys):
+        rows.append(k)
+    return np.asarray(rows)
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL004"]
+        assert "set-iteration" in findings[0].message
+
+    def test_unseeded_rng_fires_seeded_instance_clean(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+import random
+import random as _random
+import numpy as np
+def bad(keys):
+    return np.asarray(random.sample(keys, 2))
+def good(keys, rng=None):
+    rng = rng or _random.Random(0)
+    return np.asarray(rng.sample(keys, 2))
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL004"]
+        assert "random.sample" in findings[0].message
+        assert "'bad'" in findings[0].message
+
+    def test_wall_clock_and_set_sum_fire(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+import time
+import numpy as np
+def bad(xs):
+    t = time.time()
+    s = sum({x for x in xs})
+    return np.full((2,), s + t)
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert sorted(_codes(findings)) == ["PL004", "PL004"]
+
+    def test_hazard_outside_tensor_function_clean(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src=RECORD_BOTH + """
+import time
+def telemetry_stamp():
+    return time.time()
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert findings == []
+
+
+class TestPL005DeadDeclarations:
+    def test_unrecorded_path_fires(self, tmp_path):
+        eng = _mini_repo(tmp_path, module_src="""
+from . import planspec
+def f():
+    planspec.record("a.path")
+""")
+        findings, _ = planlint.lint_paths([eng])
+        assert _codes(findings) == ["PL005"]
+        assert "'b.path'" in findings[0].message
+
+
+class TestCleanRunAcceptance:
+    def test_dispatch_packages_clean(self):
+        """The acceptance gate: 0 findings over engine/ + serve/ +
+        tiers/, with the declaration floor the issue demands (>= 20
+        PathSpec/Interaction declarations, every one recorded)."""
+        findings, stats = planlint.lint_paths(DISPATCH_PACKAGES)
+        assert findings == [], [f.render() for f in findings]
+        assert stats["paths"] >= 20
+        assert stats["paths"] + stats["interactions"] >= 30
+        assert stats["records"] >= stats["paths"]
+
+    def test_cli_clean(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "planlint.py"),
+             *DISPATCH_PACKAGES],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert proc.stdout == ""
+        assert "planlint:" in proc.stderr
+
+
+class TestPlanManifest:
+    def test_static_extraction_equals_runtime_manifest(self):
+        """The lint's AST-extracted registry and the live module's
+        manifest() must be IDENTICAL — the proof the static twin lints
+        the real dispatch declarations, not a drifted copy."""
+        from cyclonus_tpu.engine import planspec
+
+        reg = planlint.load_registry(
+            os.path.join(REPO, "cyclonus_tpu", "engine", "planspec.py")
+        )
+        assert planlint.build_manifest(reg) == planspec.manifest()
+
+    def test_manifest_schema(self, tmp_path):
+        out = tmp_path / "plan_manifest.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "planlint.py"),
+             "--manifest", str(out), *DISPATCH_PACKAGES],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        m = json.loads(out.read_text())
+        assert m["version"] == 1
+        assert m["stages"] == [
+            "pre-classify", "pack", "contract", "tier-resolve", "epilogue",
+        ]
+        assert len(m["paths"]) >= 20
+        names = [p["name"] for p in m["paths"]]
+        assert len(names) == len(set(names))
+        for p in m["paths"]:
+            assert p["entry"] in m["entries"]
+            assert set(p["stages"]) <= set(m["stages"])
+            assert p["coverage"] in ("tier1", "slow", "device_only")
+            assert p["gate"]
+        for i in m["interactions"]:
+            assert i["verdict"] in ("legal", "fallback", "raise")
+            if "raise" in (i["verdict"], i["on_explicit"]):
+                assert i["message"]
+
+
+class TestPredict:
+    """predict() is the harness's twin of the live dispatch — pin its
+    route and raise semantics directly (the harness pins them against
+    the real engine)."""
+
+    def test_entry_defaults(self):
+        from cyclonus_tpu.engine import planspec
+
+        assert planspec.predict("grid", {}) == "grid.dense"
+        assert planspec.predict("grid", {"classes": True}) == "grid.classes"
+        assert planspec.predict("grid_sharded", {}) == "grid.sharded.ring"
+        assert planspec.predict("counts", {"platform": "cpu"}) == "counts.xla"
+        assert (
+            planspec.predict("counts", {"platform": "tpu"}) == "counts.pallas"
+        )
+        assert (
+            planspec.predict("counts_steady", {"pack": True})
+            == "counts.steady.default"
+        )
+        assert (
+            planspec.predict("serve_query", {"warming": True})
+            == "serve.query.degraded"
+        )
+
+    def test_matrix_fallbacks_and_raises(self):
+        from cyclonus_tpu.engine import planspec
+
+        # auto pallas under tiers falls back to xla...
+        assert (
+            planspec.predict("counts", {"platform": "tpu", "tiers": True})
+            == "counts.xla"
+        )
+        # ...unless the packed plan fuses the tier epilogue
+        assert (
+            planspec.predict("counts", {
+                "platform": "tpu", "tiers": True,
+                "pack": True, "packed_tier_ok": True,
+            })
+            == "counts.pallas"
+        )
+        # an explicit request raises the declared cell's message
+        with pytest.raises(planspec.PlanError) as exc:
+            planspec.predict("counts", {"backend": "pallas", "tiers": True})
+        assert str(exc.value) == planspec.interaction(
+            "tiers", "backend=pallas"
+        ).message
+        # pack retires the slab path before the steady dispatch sees it
+        assert (
+            planspec.predict("counts_steady", {"pack": True, "slab": True})
+            == "counts.steady.default"
+        )
+        assert (
+            planspec.predict("counts_steady", {"pack": False, "slab": True})
+            == "counts.steady.slab"
+        )
+
+    def test_recorder_stripped_when_unarmed(self):
+        """The strip contract: with CYCLONUS_PLANHARNESS unset (every
+        pytest run — conftest does not arm it) record() is a no-op and
+        drain() is empty."""
+        from cyclonus_tpu.engine import planspec
+
+        assert planspec.ACTIVE is False
+        planspec.record("grid.dense")
+        assert planspec.drain() == []
+        assert planspec.dropped() == 0
+
+
+class TestPlanHarnessTier1:
+    def test_quick_slice(self):
+        """The tier-1 dispatch-route gate: the harness quick slice in a
+        fresh subprocess (the recorder arms at import), including its
+        route-coverage census — every tier1-coverage PathSpec must be
+        recorded and match its prediction."""
+        env = dict(os.environ)
+        env.pop("CYCLONUS_CLASS_COMPRESS", None)  # harness forces per-engine
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tests.planharness"],
+            capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+        )
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        assert "coverage_census" in proc.stderr

@@ -80,16 +80,17 @@ Exit status 1 iff findings remain.
 
 from __future__ import annotations
 
-import argparse
 import ast
 import builtins
-import os
 import re
 import sys
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
-_IGNORE_RE = re.compile(r"#\s*cachelint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli
+from lintcore import suppress as _core_suppress
+
+_IGNORE_RE = ignore_regex("cachelint")
 _CACHE_KEY_RE = re.compile(r"#\s*cache-key:\s*(.+)")
 _DERIVED_RE = re.compile(r"#\s*derived-from:\s*(.+)")
 _NEVER_RAISES_RE = re.compile(r"#\s*never-raises")
@@ -123,6 +124,11 @@ SAFE_CALL_PREFIXES = (
     "math.",
     "hashlib.",
     "logging.getLogger",
+    # the central env-flag registry accessors are never-raise by
+    # construction (unparseable values degrade to the registered
+    # default; tests/test_envflags.py pins it) — both import spellings
+    "envflags.get_",
+    "utils.envflags.get_",
 )
 #: bare builtins safe to call with any argument
 SAFE_BARE_CALLS = {
@@ -145,18 +151,6 @@ SAFE_METHOD_ATTRS = {
 EVIDENCE_ATTRS = {
     "inc", "observe", "warning", "info", "error", "exception", "debug",
 }
-
-
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    col: int
-    code: str
-    message: str
-
-    def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
 
 
 def _attr_root(node: ast.AST) -> Optional[str]:
@@ -1449,36 +1443,7 @@ def analyze_file(path: str) -> Tuple[List[Finding], Dict[str, int]]:
 
 
 def _suppress(findings: List[Finding], lines: List[str]) -> List[Finding]:
-    out = []
-    seen = set()
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
-        key = (f.path, f.line, f.col, f.code, f.message)
-        if key in seen:
-            continue
-        seen.add(key)
-        line_src = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        m = _IGNORE_RE.search(line_src)
-        if m:
-            codes = m.group(1)
-            if codes is None or f.code in {c.strip() for c in codes.split(",")}:
-                continue
-        out.append(f)
-    return out
-
-
-def iter_py_files(paths: List[str]) -> List[str]:
-    out = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
-                out.extend(
-                    os.path.join(root, f)
-                    for f in sorted(files)
-                    if f.endswith(".py")
-                )
-        elif p.endswith(".py"):
-            out.append(p)
-    return out
+    return _core_suppress(findings, lines, _IGNORE_RE)
 
 
 def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
@@ -1507,25 +1472,19 @@ DEFAULT_PATHS = [
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "paths",
-        nargs="*",
-        default=DEFAULT_PATHS,
-        help=f"files/directories to lint (default: {' '.join(DEFAULT_PATHS)})",
+    return run_cli(
+        "cachelint",
+        __doc__,
+        lint_paths,
+        DEFAULT_PATHS,
+        lambda findings, stats: (
+            f"cachelint: {stats['findings']} finding(s), "
+            f"{stats['cache_keys']} cache-key / {stats['derived']} "
+            f"derived-from / {stats['never_raises']} never-raises "
+            f"annotation(s) in {stats['files']} file(s)"
+        ),
+        argv,
     )
-    args = ap.parse_args(argv)
-    findings, stats = lint_paths(args.paths)
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
-        print(f.render())
-    print(
-        f"cachelint: {stats['findings']} finding(s), "
-        f"{stats['cache_keys']} cache-key / {stats['derived']} derived-from "
-        f"/ {stats['never_raises']} never-raises annotation(s) in "
-        f"{stats['files']} file(s)",
-        file=sys.stderr,
-    )
-    return 1 if findings else 0
 
 
 if __name__ == "__main__":

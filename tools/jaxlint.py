@@ -51,13 +51,11 @@ Exit status 1 iff findings remain.
 
 from __future__ import annotations
 
-import argparse
 import ast
-import os
-import re
 import sys
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
+
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli, suppress
 
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
 COERCIONS = {"float", "int", "bool", "complex"}
@@ -68,19 +66,7 @@ HOST_CALLBACKS = {"pure_callback", "io_callback"}
 HOST_CALLBACK_MODULES = ("jax.experimental.host_callback",)
 EXEMPT_CALLS = {"isinstance", "len", "hasattr", "callable", "getattr", "type"}
 MUTABLE_DEFAULTS = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)
-_IGNORE_RE = re.compile(r"#\s*jaxlint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
-
-
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    col: int
-    code: str
-    message: str
-
-    def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+_IGNORE_RE = ignore_regex("jaxlint")
 
 
 def _attr_root(node: ast.AST) -> Optional[str]:
@@ -644,57 +630,30 @@ def lint_file(path: str) -> List[Finding]:
         findings.extend(checker.run())
         checkers.append(checker)
     findings.extend(_helper_seam_findings(info, path, checkers, jit_ids))
-    lines = source.splitlines()
-    out = []
-    seen = set()
-    for f in findings:
-        key = (f.path, f.line, f.col, f.code)
-        if key in seen:
-            continue
-        seen.add(key)
-        line_src = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        m = _IGNORE_RE.search(line_src)
-        if m:
-            codes = m.group(1)
-            if codes is None or f.code in {c.strip() for c in codes.split(",")}:
-                continue
-        out.append(f)
-    return sorted(out, key=lambda f: (f.path, f.line, f.col))
+    return suppress(
+        findings, source.splitlines(), _IGNORE_RE, key_includes_message=False
+    )
 
 
-def iter_py_files(paths: List[str]) -> List[str]:
-    out = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
-                out.extend(
-                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
-                )
-        elif p.endswith(".py"):
-            out.append(p)
-    return out
+def lint_paths(paths: List[str]):
+    findings: List[Finding] = []
+    files = iter_py_files(paths)
+    for path in files:
+        findings.extend(lint_file(path))
+    return findings, {"files": len(files), "findings": len(findings)}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "paths",
-        nargs="*",
-        default=["cyclonus_tpu/engine"],
-        help="files/directories to lint (default: cyclonus_tpu/engine)",
+    return run_cli(
+        "jaxlint",
+        __doc__,
+        lint_paths,
+        ["cyclonus_tpu/engine"],
+        lambda findings, stats: (
+            f"jaxlint: {len(findings)} finding(s) in {stats['files']} file(s)"
+        ),
+        argv,
     )
-    args = ap.parse_args(argv)
-    findings: List[Finding] = []
-    files = iter_py_files(args.paths)
-    for path in files:
-        findings.extend(lint_file(path))
-    for f in findings:
-        print(f.render())
-    print(
-        f"jaxlint: {len(findings)} finding(s) in {len(files)} file(s)",
-        file=sys.stderr,
-    )
-    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,140 @@
+"""Shared scaffolding of the five linter legs (jaxlint / locklint /
+shapelint / cachelint / planlint): the Finding record, the
+`# <tool>: ignore[CODE,...]` suppression convention, dedup, the
+filesystem walk, and the argparse CLI driver.
+
+Every leg previously carried its own copy of this file's contents; the
+behavior here is pinned by the existing test_*lint suites running
+unchanged against the importing legs.  Conventions:
+
+  * a Finding renders as `path:line:col: CODE message` (clickable);
+  * `# tool: ignore` on the offending line suppresses every code,
+    `# tool: ignore[AB001,AB002]` the listed codes only;
+  * findings are deduplicated on (path, line, col, code[, message]) and
+    reported sorted by position;
+  * the CLI lints files/directories (recursive *.py walk, sorted for
+    deterministic output), prints findings to stdout, a one-line
+    summary to stderr, and exits 1 iff findings remain.
+
+The tools directory is not a package: legs do `import lintcore`, which
+resolves because both `python tools/<leg>.py` and the test suites put
+this directory on sys.path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+def ignore_regex(tool: str) -> "re.Pattern":
+    """The per-tool suppression-comment pattern:
+    `# <tool>: ignore` / `# <tool>: ignore[CODE,...]`."""
+    return re.compile(rf"#\s*{re.escape(tool)}:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+
+
+def is_suppressed(finding: Finding, line_src: str, ignore_re) -> bool:
+    """Does the source line carry an ignore comment covering this code?"""
+    m = ignore_re.search(line_src)
+    if not m:
+        return False
+    codes = m.group(1)
+    return codes is None or finding.code in {c.strip() for c in codes.split(",")}
+
+
+def suppress(
+    findings: List[Finding],
+    lines: List[str],
+    ignore_re,
+    *,
+    key_includes_message: bool = True,
+) -> List[Finding]:
+    """Dedup + ignore-comment filter over one file's findings, sorted by
+    position.  `lines` is the file's source split into lines (used to
+    look up each finding's line for the ignore comment).  The dedup key
+    includes the message by default (two different defects on one line
+    both report); jaxlint passes False to keep its one-per-position
+    convention."""
+    out = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
+        key = (
+            (f.path, f.line, f.col, f.code, f.message)
+            if key_includes_message
+            else (f.path, f.line, f.col, f.code)
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        line_src = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        if is_suppressed(f, line_src, ignore_re):
+            continue
+        out.append(f)
+    return out
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    """Recursive, sorted *.py walk over files/directories (deterministic
+    lint output is part of the CLI contract)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f)
+                    for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def run_cli(
+    tool: str,
+    doc: Optional[str],
+    lint_paths: Callable[[List[str]], Tuple[List[Finding], Dict[str, int]]],
+    default_paths: List[str],
+    summary: Callable[[List[Finding], Dict[str, int]], str],
+    argv: Optional[List[str]] = None,
+    extra_args: Optional[Callable[[argparse.ArgumentParser], None]] = None,
+    post: Optional[Callable[[argparse.Namespace, List[Finding], Dict], None]] = None,
+) -> int:
+    """The shared argparse driver: positional paths (defaulting per
+    leg), findings to stdout sorted by position, `summary(findings,
+    stats)` to stderr, exit 1 iff findings.  `extra_args` lets a leg add
+    flags (planlint's --manifest); `post` runs after linting with the
+    parsed namespace (artifact emission)."""
+    ap = argparse.ArgumentParser(description=(doc or "").splitlines()[0])
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=default_paths,
+        help=f"files/directories to lint (default: {' '.join(default_paths)})",
+    )
+    if extra_args is not None:
+        extra_args(ap)
+    args = ap.parse_args(argv)
+    findings, stats = lint_paths(args.paths)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        print(f.render())
+    print(summary(findings, stats), file=sys.stderr)
+    if post is not None:
+        post(args, findings, stats)
+    return 1 if findings else 0

@@ -47,7 +47,6 @@ Exit status 1 iff findings remain.
 
 from __future__ import annotations
 
-import argparse
 import ast
 import os
 import re
@@ -55,7 +54,10 @@ import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-_IGNORE_RE = re.compile(r"#\s*locklint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli
+from lintcore import suppress as _core_suppress
+
+_IGNORE_RE = ignore_regex("locklint")
 _GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 _HOLDS_DOC_RE = re.compile(r"holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 # `with m._lock:  # locklint: lock-class Metric` — declares the class
@@ -76,18 +78,6 @@ BLOCKING_ATTRS = {
     "wait", "join",             # Event.wait / Thread.join
 }
 CONSTRUCTOR_EXEMPT = {"__init__", "__new__", "__set_name__", "__init_subclass__"}
-
-
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    col: int
-    code: str
-    message: str
-
-    def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
 
 
 def _expr_str(node: ast.AST) -> str:
@@ -793,34 +783,7 @@ def analyze_file(path: str) -> Tuple[List[Finding], List[Edge], int]:
 
 
 def _suppress(findings: List[Finding], lines: List[str]) -> List[Finding]:
-    out = []
-    seen = set()
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)):
-        key = (f.path, f.line, f.col, f.code, f.message)
-        if key in seen:
-            continue
-        seen.add(key)
-        line_src = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        m = _IGNORE_RE.search(line_src)
-        if m:
-            codes = m.group(1)
-            if codes is None or f.code in {c.strip() for c in codes.split(",")}:
-                continue
-        out.append(f)
-    return out
-
-
-def iter_py_files(paths: List[str]) -> List[str]:
-    out = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
-                out.extend(
-                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
-                )
-        elif p.endswith(".py"):
-            out.append(p)
-    return out
+    return _core_suppress(findings, lines, _IGNORE_RE)
 
 
 def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
@@ -856,24 +819,18 @@ def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "paths",
-        nargs="*",
-        default=["cyclonus_tpu"],
-        help="files/directories to lint (default: cyclonus_tpu)",
+    return run_cli(
+        "locklint",
+        __doc__,
+        lint_paths,
+        ["cyclonus_tpu"],
+        lambda findings, stats: (
+            f"locklint: {stats['findings']} finding(s), {stats['guarded']} "
+            f"guarded attribute(s), {stats['edges']} acquisition edge(s) in "
+            f"{stats['files']} file(s)"
+        ),
+        argv,
     )
-    args = ap.parse_args(argv)
-    findings, stats = lint_paths(args.paths)
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
-        print(f.render())
-    print(
-        f"locklint: {stats['findings']} finding(s), {stats['guarded']} "
-        f"guarded attribute(s), {stats['edges']} acquisition edge(s) in "
-        f"{stats['files']} file(s)",
-        file=sys.stderr,
-    )
-    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,627 @@
+"""planlint: static model of the evaluator dispatch surface.
+
+The fifth linter leg (jaxlint / locklint / shapelint / cachelint /
+planlint — shared scaffolding in tools/lintcore.py).  The runtime twin
+is cyclonus_tpu/engine/planspec.py: a declarative registry of evaluator
+paths (PathSpec) and pairwise feature-compatibility cells (Interaction)
+that engine/api.py's dispatch actually reads.  planlint extracts BOTH
+sides statically — the declarations from planspec.py's AST, the
+dispatch graph from the scanned engine/serve modules — and
+cross-checks them:
+
+  PL001  route-recorder literal (planspec.record("...")) that names no
+         declared PathSpec, or a record() call whose argument is not a
+         string literal (statically unverifiable route).
+  PL002  declared path with no differential gate: gate empty, or the
+         referenced tests/ file / make target does not exist.
+  PL003  feature interaction reachable in dispatch (two governing
+         features combined in one boolean test, or a matrix-backed
+         resolver call) with no declared Interaction cell.
+  PL004  determinism hazard on a verdict-affecting path (a function
+         that constructs tensors): set-display/set()/set-comprehension
+         iteration order feeding the function, module-level unseeded
+         rng reads (random.*, np.random.*), wall-clock time.time()
+         reads, or unordered (set-sourced) float accumulation.  Seeded
+         generator INSTANCES (random.Random(k)) and monotonic clocks
+         (perf_counter) are not hazards.
+  PL005  declared PathSpec no scanned record() literal ever routes to
+         (dead declaration).
+
+Suppress a finding with `# planlint: ignore[PL00X]` on the offending
+line.  `--manifest PATH` additionally emits the extracted registry as
+JSON (the plan manifest tests/test_planlint.py schema-checks and `make
+planlint` writes to artifacts/plan_manifest.json).
+
+Run: python tools/planlint.py [--manifest artifacts/plan_manifest.json] [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli, suppress
+
+_IGNORE_RE = ignore_regex("planlint")
+
+DEFAULT_PATHS = [
+    "cyclonus_tpu/engine",
+    "cyclonus_tpu/serve",
+    "cyclonus_tpu/tiers",
+]
+
+PLANSPEC_BASENAME = "planspec.py"
+
+
+# --------------------------------------------------------------------------
+# Registry extraction: planspec.py's PATHS / INTERACTIONS tuples are
+# literal PathSpec(...) / Interaction(...) calls — read them off the
+# AST so the lint needs no runtime import (and a syntax error in the
+# package cannot take the linter down with it).
+# --------------------------------------------------------------------------
+
+@dataclass
+class SpecDecl:
+    name: str
+    entry: str
+    gate: str
+    coverage: str
+    line: int
+    fields: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class InterDecl:
+    a: str
+    b: str
+    verdict: str
+    line: int
+    fields: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class Registry:
+    path: str = ""
+    stages: Tuple[str, ...] = ()
+    specs: List[SpecDecl] = field(default_factory=list)
+    inters: List[InterDecl] = field(default_factory=list)
+
+    def spec_names(self) -> Set[str]:
+        return {s.name for s in self.specs}
+
+    def has_cell(self, a: str, b: str) -> bool:
+        for i in self.inters:
+            if (i.a, i.b) == (a, b) or (i.a, i.b) == (b, a):
+                return True
+        return False
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _call_kwargs(call: ast.Call, positional: List[str]) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for i, arg in enumerate(call.args):
+        if i < len(positional):
+            out[positional[i]] = _literal(arg)
+    for kw in call.keywords:
+        if kw.arg:
+            out[kw.arg] = _literal(kw.value)
+    return out
+
+
+def load_registry(planspec_path: str) -> Optional[Registry]:
+    try:
+        with open(planspec_path, "r") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    reg = Registry(path=planspec_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "STAGES":
+                    val = _literal(node.value)
+                    if isinstance(val, tuple):
+                        reg.stages = val
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+        if name == "PathSpec":
+            kw = _call_kwargs(node, ["name", "entry"])
+            reg.specs.append(
+                SpecDecl(
+                    name=str(kw.get("name") or ""),
+                    entry=str(kw.get("entry") or ""),
+                    gate=str(kw.get("gate") or ""),
+                    coverage=str(kw.get("coverage") or "tier1"),
+                    line=node.lineno,
+                    fields=kw,
+                )
+            )
+        elif name == "Interaction":
+            kw = _call_kwargs(node, ["a", "b", "verdict"])
+            reg.inters.append(
+                InterDecl(
+                    a=str(kw.get("a") or ""),
+                    b=str(kw.get("b") or ""),
+                    verdict=str(kw.get("verdict") or ""),
+                    line=node.lineno,
+                    fields=kw,
+                )
+            )
+    return reg
+
+
+def find_planspec(paths: List[str]) -> Optional[str]:
+    """Locate planspec.py: inside a scanned directory, else relative to
+    the repo root the scanned paths live under."""
+    for p in paths:
+        if os.path.isdir(p):
+            cand = os.path.join(p, PLANSPEC_BASENAME)
+            if os.path.exists(cand):
+                return cand
+        elif os.path.basename(p) == PLANSPEC_BASENAME:
+            return p
+    # walk up from the first path to a dir holding cyclonus_tpu/engine
+    anchor = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+    for _ in range(6):
+        cand = os.path.join(cur, "cyclonus_tpu", "engine", PLANSPEC_BASENAME)
+        if os.path.exists(cand):
+            return cand
+        cur = os.path.dirname(cur)
+    return None
+
+
+# --------------------------------------------------------------------------
+# Dispatch-graph extraction from the scanned modules.
+# --------------------------------------------------------------------------
+
+def _func_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# A resolver call is a matrix read: the cell it consults must exist.
+RESOLVER_CELLS = {
+    "resolve_counts_backend": ("tiers", "backend=pallas"),
+    "resolve_sharded_counts_kernel": ("tiers", "kernel=pallas"),
+}
+
+# Governing-feature signals recognized inside one boolean test.
+_ATTR_FEATURES = {
+    "tiers": "tiers",
+    "_class_state": "classes",
+    "_pack": "pack",
+    "_slab_plan_state": "slab",
+}
+_CALL_FEATURES = {
+    "_class_counts_eligible": "over_budget",
+    "_packed_tier_ok": "packed_tier_ok",
+    "_pre_cache_enabled": "pre_cache=0",
+}
+_NAME_FEATURES = {
+    "slab_ok": "slab",
+}
+
+
+def _features_in(node: ast.AST) -> Set[str]:
+    feats: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _ATTR_FEATURES:
+            feats.add(_ATTR_FEATURES[sub.attr])
+        elif isinstance(sub, ast.Call):
+            fn = _func_name(sub)
+            if fn in _CALL_FEATURES:
+                feats.add(_CALL_FEATURES[fn])
+            elif fn == "is_set" and _attr_chain(sub.func).startswith(
+                "self._ready"
+            ):
+                feats.add("warming")
+        elif isinstance(sub, ast.Name) and sub.id in _NAME_FEATURES:
+            feats.add(_NAME_FEATURES[sub.id])
+        elif isinstance(sub, ast.Compare) and isinstance(sub.left, ast.Name):
+            if sub.left.id in ("backend", "kernel"):
+                for cmp in sub.comparators:
+                    val = _literal(cmp)
+                    if val == "pallas":
+                        feats.add(f"{sub.left.id}=pallas")
+                    elif val == "xla" and isinstance(
+                        sub.ops[0], (ast.NotEq, ast.IsNot)
+                    ):
+                        feats.add(f"{sub.left.id}=pallas")
+    return feats
+
+
+_TENSOR_CTORS = {
+    "array", "asarray", "stack", "concatenate", "zeros", "ones", "full",
+    "arange", "frombuffer", "device_put",
+}
+
+
+def _is_tensor_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _TENSOR_CTORS:
+        return False
+    root = _attr_chain(fn).split(".", 1)[0]
+    return root in ("np", "numpy", "jnp", "jax")
+
+
+_RNG_MODULES = ("random", "np.random", "numpy.random", "_random")
+
+
+def _is_unseeded_rng(call: ast.Call) -> bool:
+    """Module-level rng read (random.sample(...), np.random.rand(...)).
+    Constructing a seeded generator (Random(k), default_rng(k),
+    RandomState(k)) is NOT a hazard — the hazard is drawing from global
+    unseeded state on a verdict path."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    chain = _attr_chain(fn)
+    mod, _, leaf = chain.rpartition(".")
+    if mod not in _RNG_MODULES:
+        return False
+    return leaf not in ("Random", "SystemRandom", "default_rng", "RandomState", "seed")
+
+
+def _contains_set_source(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(sub, ast.Call) and _func_name(sub) in ("set", "frozenset"):
+            return True
+    return False
+
+
+@dataclass
+class ModuleScan:
+    path: str
+    record_literals: List[Tuple[str, int, int]] = field(default_factory=list)
+    record_dynamic: List[Tuple[int, int]] = field(default_factory=list)
+    resolver_calls: List[Tuple[str, int, int]] = field(default_factory=list)
+    feature_pairs: List[Tuple[str, str, int, int]] = field(default_factory=list)
+    hazards: List[Finding] = field(default_factory=list)
+    lines: List[str] = field(default_factory=list)
+
+
+def scan_module(path: str, source: str) -> Optional[ModuleScan]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    scan = ModuleScan(path=path, lines=source.splitlines())
+
+    # record() literals + resolver calls + interaction tests
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            chain = _attr_chain(fn) if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf == "record" and chain.endswith(("planspec.record",)):
+                if node.args and isinstance(node.args[0], ast.Constant) and (
+                    isinstance(node.args[0].value, str)
+                ):
+                    scan.record_literals.append(
+                        (node.args[0].value, node.lineno, node.col_offset)
+                    )
+                else:
+                    scan.record_dynamic.append((node.lineno, node.col_offset))
+            elif leaf in RESOLVER_CELLS:
+                scan.resolver_calls.append(
+                    (leaf, node.lineno, node.col_offset)
+                )
+        test = None
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+        elif isinstance(node, ast.IfExp):
+            test = node.test
+        elif isinstance(node, ast.BoolOp):
+            test = node
+        if test is not None:
+            feats = sorted(_features_in(test))
+            for i in range(len(feats)):
+                for j in range(i + 1, len(feats)):
+                    scan.feature_pairs.append(
+                        (feats[i], feats[j], test.lineno, test.col_offset)
+                    )
+
+    # PL004: determinism hazards, scoped to tensor-constructing functions
+    for fnode in ast.walk(tree):
+        if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        builds_tensors = any(
+            isinstance(sub, ast.Call) and _is_tensor_ctor(sub)
+            for sub in ast.walk(fnode)
+        )
+        if not builds_tensors:
+            continue
+        for sub in ast.walk(fnode):
+            if isinstance(sub, ast.For) and _contains_set_source(sub.iter):
+                scan.hazards.append(Finding(
+                    path, sub.lineno, sub.col_offset, "PL004",
+                    f"set-iteration order feeds tensor-constructing "
+                    f"function {fnode.name!r} (wrap in sorted())",
+                ))
+            elif isinstance(sub, ast.Call):
+                fn_name = _func_name(sub)
+                chain = _attr_chain(sub.func) if isinstance(
+                    sub.func, ast.Attribute
+                ) else fn_name
+                if _is_unseeded_rng(sub):
+                    scan.hazards.append(Finding(
+                        path, sub.lineno, sub.col_offset, "PL004",
+                        f"unseeded rng read {chain!r} on a verdict-"
+                        f"affecting path ({fnode.name!r}); draw from a "
+                        f"seeded generator instance",
+                    ))
+                elif chain in ("time.time", "_time.time", "datetime.now"):
+                    scan.hazards.append(Finding(
+                        path, sub.lineno, sub.col_offset, "PL004",
+                        f"wall-clock read {chain!r} on a verdict-"
+                        f"affecting path ({fnode.name!r})",
+                    ))
+                elif fn_name == "sum" and sub.args and _contains_set_source(
+                    sub.args[0]
+                ):
+                    scan.hazards.append(Finding(
+                        path, sub.lineno, sub.col_offset, "PL004",
+                        f"unordered accumulation over a set in "
+                        f"{fnode.name!r} (float sum order is "
+                        f"iteration order)",
+                    ))
+    return scan
+
+
+# --------------------------------------------------------------------------
+# Cross-checks.
+# --------------------------------------------------------------------------
+
+def _repo_root_for(planspec_path: str) -> str:
+    # .../cyclonus_tpu/engine/planspec.py -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(planspec_path)
+    )))
+
+
+def _gate_exists(gate: str, root: str) -> bool:
+    if gate.startswith("tests/"):
+        return os.path.exists(os.path.join(root, gate))
+    if gate.startswith("make "):
+        target = gate.split(None, 1)[1]
+        mk = os.path.join(root, "Makefile")
+        if not os.path.exists(mk):
+            return False
+        with open(mk) as f:
+            return re.search(
+                rf"^{re.escape(target)}:", f.read(), re.MULTILINE
+            ) is not None
+    return False
+
+
+def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, object]]:
+    files = iter_py_files(paths)
+    planspec_path = find_planspec(paths)
+    findings: List[Finding] = []
+    if planspec_path is None:
+        findings.append(Finding(
+            paths[0] if paths else ".", 0, 0, "PL001",
+            "cyclonus_tpu/engine/planspec.py not found: the dispatch "
+            "surface has no declared registry to lint against",
+        ))
+        return findings, {"files": len(files), "paths": 0, "interactions": 0,
+                          "records": 0, "findings": len(findings)}
+    reg = load_registry(planspec_path)
+    if reg is None or not reg.specs:
+        findings.append(Finding(
+            planspec_path, 0, 0, "PL001",
+            "planspec registry unparseable or empty",
+        ))
+        return findings, {"files": len(files), "paths": 0, "interactions": 0,
+                          "records": 0, "findings": len(findings)}
+
+    root = _repo_root_for(planspec_path)
+    declared = reg.spec_names()
+    recorded: Set[str] = set()
+    per_file: List[Tuple[ModuleScan, List[Finding]]] = []
+
+    for path in files:
+        if os.path.basename(path) == PLANSPEC_BASENAME:
+            continue  # the registry itself is not a dispatch site
+        with open(path, "r") as f:
+            source = f.read()
+        scan = scan_module(path, source)
+        if scan is None:
+            findings.append(Finding(path, 0, 0, "PL000", "syntax error"))
+            continue
+        file_findings: List[Finding] = []
+        for name, line, col in scan.record_literals:
+            recorded.add(name)
+            if name not in declared:
+                file_findings.append(Finding(
+                    path, line, col, "PL001",
+                    f"route target {name!r} is not a declared PathSpec",
+                ))
+        for line, col in scan.record_dynamic:
+            file_findings.append(Finding(
+                path, line, col, "PL001",
+                "planspec.record() argument is not a string literal: "
+                "the route cannot be statically verified",
+            ))
+        for resolver, line, col in scan.resolver_calls:
+            a, b = RESOLVER_CELLS[resolver]
+            if not reg.has_cell(a, b):
+                file_findings.append(Finding(
+                    path, line, col, "PL003",
+                    f"dispatch resolves the ({a!r}, {b!r}) interaction "
+                    f"but the compatibility matrix declares no such cell",
+                ))
+        seen_pairs: Set[Tuple[str, str, int]] = set()
+        for a, b, line, col in scan.feature_pairs:
+            key = (a, b, line)
+            if key in seen_pairs:
+                continue
+            seen_pairs.add(key)
+            if not reg.has_cell(a, b):
+                file_findings.append(Finding(
+                    path, line, col, "PL003",
+                    f"dispatch combines features {a!r} x {b!r} but the "
+                    f"compatibility matrix declares no such cell",
+                ))
+        file_findings.extend(scan.hazards)
+        per_file.append((scan, file_findings))
+        findings.extend(
+            suppress(file_findings, scan.lines, _IGNORE_RE)
+        )
+
+    # registry-side checks (anchored at the declaration lines; the
+    # registry file's own ignore comments apply)
+    reg_findings: List[Finding] = []
+    for spec in reg.specs:
+        if not spec.gate:
+            reg_findings.append(Finding(
+                planspec_path, spec.line, 0, "PL002",
+                f"path {spec.name!r} declares no differential gate",
+            ))
+        elif not _gate_exists(spec.gate, root):
+            reg_findings.append(Finding(
+                planspec_path, spec.line, 0, "PL002",
+                f"path {spec.name!r} gate {spec.gate!r} does not exist "
+                f"(want an existing tests/ file or make target)",
+            ))
+        if spec.name not in recorded:
+            reg_findings.append(Finding(
+                planspec_path, spec.line, 0, "PL005",
+                f"declared path {spec.name!r} is unreachable: no "
+                f"scanned dispatch site records it",
+            ))
+    with open(planspec_path, "r") as f:
+        reg_lines = f.read().splitlines()
+    findings.extend(suppress(reg_findings, reg_lines, _IGNORE_RE))
+
+    n_records = sum(len(s.record_literals) for s, _ in per_file)
+    stats = {
+        "files": len(files),
+        "paths": len(reg.specs),
+        "interactions": len(reg.inters),
+        "records": n_records,
+        "findings": len(findings),
+        "registry": reg,
+        "planspec_path": planspec_path,
+    }
+    return (
+        sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)),
+        stats,
+    )
+
+
+# --------------------------------------------------------------------------
+# Manifest emission.
+# --------------------------------------------------------------------------
+
+def build_manifest(reg: Registry) -> Dict:
+    return {
+        "version": 1,
+        "entries": sorted({s.entry for s in reg.specs}),
+        "stages": list(reg.stages),
+        "paths": [
+            {
+                "name": s.name,
+                "entry": s.entry,
+                "stages": list(s.fields.get("stages") or ()),
+                "flags": list(s.fields.get("flags") or ()),
+                "ctor_args": list(s.fields.get("ctor_args") or ()),
+                "cache_key_family": s.fields.get("cache_key_family") or "",
+                "gate": s.gate,
+                "backends": list(s.fields.get("backends") or ("cpu", "tpu")),
+                "coverage": s.coverage,
+                "when": dict(s.fields.get("when") or {}),
+            }
+            for s in reg.specs
+        ],
+        "interactions": [
+            {
+                "a": i.a,
+                "b": i.b,
+                "verdict": i.verdict,
+                "on_explicit": i.fields.get("on_explicit") or "",
+                "unless": list(i.fields.get("unless") or ()),
+                "resolves_to": i.fields.get("resolves_to") or "",
+                "message": i.fields.get("message") or "",
+                "note": i.fields.get("note") or "",
+            }
+            for i in reg.inters
+        ],
+    }
+
+
+def write_manifest(path: str, reg: Registry) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(build_manifest(reg), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _extra_args(ap) -> None:
+    ap.add_argument(
+        "--manifest",
+        default=None,
+        help="also write the extracted plan manifest JSON here",
+    )
+
+
+def _post(args, findings, stats) -> None:
+    reg = stats.pop("registry", None)
+    stats.pop("planspec_path", None)
+    if getattr(args, "manifest", None) and reg is not None:
+        write_manifest(args.manifest, reg)
+        print(f"planlint: wrote {args.manifest}", file=sys.stderr)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run_cli(
+        "planlint",
+        __doc__,
+        lint_paths,
+        DEFAULT_PATHS,
+        lambda findings, stats: (
+            f"planlint: {len(findings)} finding(s), "
+            f"{stats['paths']} path / {stats['interactions']} interaction "
+            f"declaration(s), {stats['records']} route record(s) in "
+            f"{stats['files']} file(s)"
+        ),
+        argv,
+        extra_args=_extra_args,
+        post=_post,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

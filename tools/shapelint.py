@@ -48,13 +48,13 @@ Exit status 1 iff findings remain.
 
 from __future__ import annotations
 
-import argparse
 import ast
-import os
 import re
 import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli, suppress
 
 SIGNED = {"int8", "int16", "int32", "int64"}
 UNSIGNED = {"uint8", "uint16", "uint32", "uint64"}
@@ -63,7 +63,7 @@ DTYPES = SIGNED | UNSIGNED | FLOATS | {"bool"}
 ARRAY_MODULES = {"np", "numpy", "jnp"}
 LANE = 128
 
-_IGNORE_RE = re.compile(r"#\s*shapelint:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+_IGNORE_RE = ignore_regex("shapelint")
 _CANON_RE = re.compile(
     r"#\s*shape:\s*[(\[]([^)\]]*)[)\]]\s*([A-Za-z_][A-Za-z0-9_]*)?"
 )
@@ -74,18 +74,6 @@ _LEGACY_RE = re.compile(
 )
 _LEGACY_PAD_RE = re.compile(r"\bpad\s+(-?\d+)")
 _TILE_RE = re.compile(r"#\s*tile:\s*(\d+)")
-
-
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    col: int
-    code: str
-    message: str
-
-    def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
 
 
 @dataclass(frozen=True)
@@ -1448,21 +1436,6 @@ class Checker:
 # --- driver ---------------------------------------------------------------
 
 
-def iter_py_files(paths: List[str]) -> List[str]:
-    out = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
-                out.extend(
-                    os.path.join(root, f)
-                    for f in sorted(files)
-                    if f.endswith(".py")
-                )
-        elif p.endswith(".py"):
-            out.append(p)
-    return out
-
-
 def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
     files = iter_py_files(paths)
     scans: List[ModuleScan] = []
@@ -1478,25 +1451,9 @@ def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
         scans.append(scan)
         registry.absorb(scan)
     for scan in scans:
-        raw = Checker(scan, registry).run()
-        # suppression + dedup (same convention as jaxlint)
-        seen: Set[Tuple[str, int, int, str, str]] = set()
-        for f in raw:
-            key = (f.path, f.line, f.col, f.code, f.message)
-            if key in seen:
-                continue
-            seen.add(key)
-            line_src = (
-                scan.lines[f.line - 1] if 0 < f.line <= len(scan.lines) else ""
-            )
-            m = _IGNORE_RE.search(line_src)
-            if m:
-                codes = m.group(1)
-                if codes is None or f.code in {
-                    c.strip() for c in codes.split(",")
-                }:
-                    continue
-            findings.append(f)
+        findings.extend(
+            suppress(Checker(scan, registry).run(), scan.lines, _IGNORE_RE)
+        )
     stats = {
         "contracts": sum(s.n_annotations for s in scans),
         "files": len(files),
@@ -1508,23 +1465,17 @@ def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, int]]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "paths",
-        nargs="*",
-        default=["cyclonus_tpu/engine"],
-        help="files/directories to lint (default: cyclonus_tpu/engine)",
+    return run_cli(
+        "shapelint",
+        __doc__,
+        lint_paths,
+        ["cyclonus_tpu/engine"],
+        lambda findings, stats: (
+            f"shapelint: {len(findings)} finding(s), {stats['contracts']} "
+            f"contract annotation(s) in {stats['files']} file(s)"
+        ),
+        argv,
     )
-    args = ap.parse_args(argv)
-    findings, stats = lint_paths(args.paths)
-    for f in findings:
-        print(f.render())
-    print(
-        f"shapelint: {len(findings)} finding(s), {stats['contracts']} "
-        f"contract annotation(s) in {stats['files']} file(s)",
-        file=sys.stderr,
-    )
-    return 1 if findings else 0
 
 
 if __name__ == "__main__":
