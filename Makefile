@@ -37,14 +37,15 @@ lint: shapelint cachelint planlint
 	python tools/jaxlint.py cyclonus_tpu/engine cyclonus_tpu/telemetry \
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
 	  cyclonus_tpu/perfobs cyclonus_tpu/serve cyclonus_tpu/tiers \
-	  cyclonus_tpu/chaos cyclonus_tpu/linter cyclonus_tpu/recipes
+	  cyclonus_tpu/chaos cyclonus_tpu/linter cyclonus_tpu/recipes \
+	  cyclonus_tpu/slo
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
 	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve \
 	  cyclonus_tpu/tiers cyclonus_tpu/chaos cyclonus_tpu/linter \
-	  cyclonus_tpu/recipes
+	  cyclonus_tpu/recipes cyclonus_tpu/slo
 
 cachelint:
 	python tools/cachelint.py cyclonus_tpu/engine cyclonus_tpu/serve \
@@ -52,7 +53,8 @@ cachelint:
 
 planlint:
 	python tools/planlint.py --manifest artifacts/plan_manifest.json \
-	  cyclonus_tpu/engine cyclonus_tpu/serve cyclonus_tpu/tiers
+	  cyclonus_tpu/engine cyclonus_tpu/serve cyclonus_tpu/tiers \
+	  cyclonus_tpu/slo
 
 # git-diff-scoped lint: run only the legs whose scanned paths contain a
 # file changed vs the merge base (falls back to HEAD for a clean tree).
@@ -139,12 +141,25 @@ multichip-smoke:
 chaos:
 	JAX_PLATFORMS=cpu python -m cyclonus_tpu chaos --seed 0
 
+# the SLO gate (docs/DESIGN.md "SLO engine"): the unit legs — burn-rate
+# math against synthetic histogram streams with pinned exhaustion
+# instants, hysteresis entry/exit, the /slo payload + gauge-name pins,
+# shed/admission enforcement with the differential gate — then the
+# enforcement drill (tools/slo_drill.py): REAL overload until the
+# query_p99 budget exhausts and queries shed (every non-shed answer
+# bit-identical to an unenforced twin), then budget recovery back to
+# live.  Seconds-bounded via shrunk windows, so it rides inside
+# `make check`.
+slo:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q
+	JAX_PLATFORMS=cpu python tools/slo_drill.py
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
 # smoke the verdict service and the 8-device overlapped mesh path, run
 # the seeded tier fuzz gate (mesh leg included), run the chaos suite,
 # then run the suite on a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke fuzz chaos
+check: vet lint perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke slo fuzz chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -193,4 +208,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos fmt vet lint lint-changed shapelint cachelint planlint keyharness planharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos slo fmt vet lint lint-changed shapelint cachelint planlint keyharness planharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker

@@ -930,8 +930,8 @@ def serve_churn_case(cases, headline_pods: int, headline_policies: int) -> dict:
 
     from cyclonus_tpu import telemetry
     from cyclonus_tpu.serve import VerdictService
-    from cyclonus_tpu.serve.service import histogram_quantile
     from cyclonus_tpu.telemetry import instruments as ti
+    from cyclonus_tpu.telemetry.metrics import histogram_quantile
     from cyclonus_tpu.worker.model import Delta, FlowQuery
 
     n_pods = int(
@@ -964,6 +964,7 @@ def serve_churn_case(cases, headline_pods: int, headline_policies: int) -> dict:
     device_puts0 = spans.get("engine.device_put", {}).get("count", 0)
     patch_bytes0 = ti.SERVE_PATCH_BYTES.value()
     headroom_saves0 = ti.SERVE_HEADROOM_SAVES.value()
+    shed0 = ti.SLO_SHED.value()
     apply_times, query_times, n_queries = [], [], 0
     for step in range(k_deltas):
         key = keys[rng.randrange(len(keys))]
@@ -1063,6 +1064,15 @@ def serve_churn_case(cases, headline_pods: int, headline_policies: int) -> dict:
         "no_reencode": True,
         "applies": st["applies"],
         "parity": parity,
+        # SLO accounting (enforcement stays disarmed in the bench):
+        # shed_rate should be 0.0 and the query_p99 budget healthy —
+        # the perfobs sentinel warn-tracks both across rounds
+        "shed_rate": round(
+            (ti.SLO_SHED.value() - shed0) / max(n_queries, 1), 4
+        ),
+        "slo_budget_remaining": st["slo"]["objectives"]["query_p99"][
+            "budget_remaining"
+        ],
     }
 
 

@@ -457,8 +457,86 @@ def scenario_delta_drop(seed: int = 0, n_pods: int = 16) -> Dict:
         disarm(tok)
 
 
+def scenario_slo_ttfv(
+    seed: int = 0,
+    workdir: Optional[str] = None,
+) -> Dict:
+    """The SLO leg, both halves of the ttfv objective's contract:
+
+    (a) kill/restart mid-churn must stay inside the DECLARED
+        time-to-first-verdict error budget — CYCLONUS_SLO_TTFV_S, the
+        same target the in-service controller enforces, not the looser
+        harness bound — so the chaos suite and the SLO engine cannot
+        drift apart on what a tolerable restart is;
+    (b) the breach path: an over-budget first verdict (forced with a
+        tiny target) must dump the flight recorder with the triggering
+        objective in its reason, because a breach nobody can diagnose
+        afterwards is just an outage with a counter."""
+    import dataclasses
+    import tempfile
+
+    from ..slo.engine import SloController
+    from ..slo.objectives import declared_objectives
+    from ..utils import envflags
+
+    workdir = workdir or tempfile.mkdtemp(prefix="cyclonus-chaos-slo-")
+
+    # (a) restart bounded by the declared objective (smaller cluster
+    # than serve_kill_restart: this leg asserts the budget, not churn
+    # breadth, and the suite pays both scenarios)
+    ttfv_target = envflags.get_float("CYCLONUS_SLO_TTFV_S")
+    restart = scenario_serve_kill_restart(
+        seed=seed, workdir=workdir, n_pods=12, churn_steps=3,
+        ttfv_bound_s=ttfv_target,
+    )
+
+    # (b) forced breach -> black-box dump naming the objective
+    dump_file = os.path.join(workdir, "slo-breach.json")
+    ttfv_obj = next(o for o in declared_objectives() if o.name == "ttfv")
+    ctl = SloController(
+        [dataclasses.replace(ttfv_obj, target_s=0.001)], enforce=True
+    )
+    prev = os.environ.get("CYCLONUS_FLIGHT_RECORDER_PATH")
+    os.environ["CYCLONUS_FLIGHT_RECORDER_PATH"] = dump_file
+    try:
+        ctl.observe_ttfv(5.0)  # 5s against a 1ms target: exhaustion
+    finally:
+        if prev is None:
+            os.environ.pop("CYCLONUS_FLIGHT_RECORDER_PATH", None)
+        else:
+            os.environ["CYCLONUS_FLIGHT_RECORDER_PATH"] = prev
+    if ctl.state_of("ttfv") != "exhausted":
+        raise AssertionError(
+            f"over-budget ttfv left state {ctl.state_of('ttfv')!r}, "
+            "expected 'exhausted'"
+        )
+    if not os.path.exists(dump_file):
+        raise AssertionError("slo breach produced no flight-recorder dump")
+    with open(dump_file) as f:
+        dumped = json.load(f)
+    if dumped.get("reason") != "slo-breach:ttfv":
+        raise AssertionError(
+            f"breach dump reason {dumped.get('reason')!r} does not name "
+            "the objective (want 'slo-breach:ttfv')"
+        )
+    breach_entries = [
+        e for e in dumped.get("entries") or []
+        if e.get("path") == "slo.breach"
+    ]
+    if not breach_entries:
+        raise AssertionError("breach dump carries no slo.breach entry")
+    return {
+        "ok": True,
+        "restart": restart,
+        "ttfv_budget_s": ttfv_target,
+        "breach_dump": dump_file,
+        "breach_reason": dumped["reason"],
+    }
+
+
 SCENARIOS = {
     "serve_kill_restart": scenario_serve_kill_restart,
+    "slo_ttfv": scenario_slo_ttfv,
     "poisoned_caches": scenario_poisoned_caches,
     "backend_init_flake": scenario_backend_init_flake,
     "worker_wire": scenario_worker_wire,

@@ -31,8 +31,8 @@ def setup_chaos(sub) -> None:
         default=None,
         metavar="NAME",
         help="run only this scenario (repeatable); default: all of "
-        "serve_kill_restart, poisoned_caches, backend_init_flake, "
-        "worker_wire, delta_drop",
+        "serve_kill_restart, slo_ttfv, poisoned_caches, "
+        "backend_init_flake, worker_wire, delta_drop",
     )
     cmd.add_argument(
         "--bound",

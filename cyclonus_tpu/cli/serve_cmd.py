@@ -147,11 +147,10 @@ def run_serve(args) -> int:
         pods, namespaces = synthesize_cluster(compiled)
     for p in policies:
         namespaces.setdefault(p.effective_namespace(), {})
-    import os
+    from ..utils import envflags
 
     prewarm_on = (
-        not args.no_prewarm
-        and os.environ.get("CYCLONUS_SERVE_PREWARM", "1") != "0"
+        not args.no_prewarm and envflags.get_bool("CYCLONUS_SERVE_PREWARM")
     )
     service = VerdictService(
         pods,
@@ -178,7 +177,8 @@ def run_serve(args) -> int:
         print(
             f"serve: metrics on {srv.url}/metrics, state on "
             f"{srv.url}/state, queries on {srv.url}/query, readiness "
-            f"on {srv.url}/readyz (port {srv.port})",
+            f"on {srv.url}/readyz, slo on {srv.url}/slo "
+            f"(port {srv.port})",
             file=sys.stderr,
         )
     if prewarm_on:

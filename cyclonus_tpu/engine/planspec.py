@@ -277,7 +277,7 @@ PATHS: Tuple[PathSpec, ...] = (
                "CYCLONUS_AOT_CACHE"),
         cache_key_family="pairs",
         gate="tests/test_serve.py",
-        when={"warming": False},
+        when={"warming": False, "shed": False},
     ),
     PathSpec(
         "serve.query.degraded", "serve_query",
@@ -285,7 +285,15 @@ PATHS: Tuple[PathSpec, ...] = (
         flags=("CYCLONUS_SERVE_PREWARM",),
         cache_key_family="",  # scalar oracle: no compiled program
         gate="tests/test_serve.py",
-        when={"warming": True},
+        when={"warming": True, "shed": False},
+    ),
+    PathSpec(
+        "serve.query.shed", "serve_query",
+        stages=("epilogue",),  # typed refusal: no engine work at all
+        flags=("CYCLONUS_SLO_ENFORCE",),
+        cache_key_family="",  # no compiled program is ever dispatched
+        gate="tests/test_slo.py",
+        when={"shed": True},
     ),
 )
 
@@ -396,6 +404,27 @@ INTERACTIONS: Tuple[Interaction, ...] = (
             "cyclonus_tpu_serve_degraded_queries_total"
         ),
     ),
+    Interaction(
+        "slo=exhausted", "query", "fallback",
+        resolves_to="route=serve.query.shed",
+        note=(
+            "query_p99 error budget exhausted (CYCLONUS_SLO_ENFORCE): "
+            "queries get a typed Shed refusal — never a wrong verdict; "
+            "the refusal carries shed=True plus an error so the "
+            "all-False allow bits cannot be misread as deny"
+        ),
+    ),
+    Interaction(
+        "slo=burning", "query", "fallback",
+        resolves_to="route=serve.query.degraded",
+        note=(
+            "query_p99 budget burning routes queries onto the same "
+            "scalar-oracle path warming uses — exact answers at host "
+            "speed while device load drains; hysteresis "
+            "(CYCLONUS_SLO_EXIT_BURN + CYCLONUS_SLO_HOLD_S) keeps the "
+            "route from flapping"
+        ),
+    ),
 )
 
 _INTER_INDEX: Dict[Tuple[str, str], Interaction] = {
@@ -502,6 +531,7 @@ def predict(entry: str, features: Mapping[str, object]) -> str:
         f.setdefault("tuned", False)
     elif entry == "serve_query":
         f.setdefault("warming", False)
+        f.setdefault("shed", False)
     candidates = [
         p for p in PATHS if p.entry == entry and p.matches(f)
     ]

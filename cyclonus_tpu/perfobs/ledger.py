@@ -188,6 +188,12 @@ def _bench_run_from_parsed(
             run.serve_full_rebuild_s = float(serve["full_rebuild_s"])
         if isinstance(serve.get("queries_per_sec"), (int, float)):
             run.serve_queries_per_sec = float(serve["queries_per_sec"])
+        if isinstance(serve.get("shed_rate"), (int, float)):
+            run.serve_shed_rate = float(serve["shed_rate"])
+        if isinstance(serve.get("slo_budget_remaining"), (int, float)):
+            run.serve_slo_budget_remaining = float(
+                serve["slo_budget_remaining"]
+            )
     tiers = detail.get("tiers")
     if isinstance(tiers, dict):
         run.tiers_active = bool(tiers.get("active"))

@@ -90,6 +90,12 @@ class PerfRun:
     serve_incremental_apply_s: Optional[float] = None
     serve_full_rebuild_s: Optional[float] = None
     serve_queries_per_sec: Optional[float] = None
+    # detail.serve SLO fields (None: leg skipped or an older artifact).
+    # Warn-only in the sentinel like the other serve fields: a rising
+    # shed rate or a sinking budget under the SAME churn workload is a
+    # latency regression the p99 gate may smooth over.
+    serve_shed_rate: Optional[float] = None
+    serve_slo_budget_remaining: Optional[float] = None
     # detail.tiers — the precedence-tier bench leg (None/False: leg
     # skipped or an older artifact).  Warn-only in the sentinel like
     # class_compression_ratio: the leg's own oracle spot-parity
@@ -166,6 +172,8 @@ class PerfRun:
             "serve_incremental_apply_s": self.serve_incremental_apply_s,
             "serve_full_rebuild_s": self.serve_full_rebuild_s,
             "serve_queries_per_sec": self.serve_queries_per_sec,
+            "serve_shed_rate": self.serve_shed_rate,
+            "serve_slo_budget_remaining": self.serve_slo_budget_remaining,
             "tiers_active": self.tiers_active,
             "tiers_anp_count": self.tiers_anp_count,
             "tiers_resolve_s": self.tiers_resolve_s,

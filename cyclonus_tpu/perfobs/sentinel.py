@@ -394,6 +394,42 @@ def gate(
                 f"{candidate.serve_queries_per_sec:g}/s vs best "
                 f"{best_qps:g}/s — reported only (warn, not fail)"
             )
+    shed_base = [
+        r.serve_shed_rate
+        for r in baselines
+        if isinstance(r.serve_shed_rate, (int, float))
+    ]
+    if shed_base and isinstance(candidate.serve_shed_rate, (int, float)):
+        best_shed = min(shed_base)
+        if (
+            candidate.serve_shed_rate > 0.01
+            and candidate.serve_shed_rate > 2.0 * max(best_shed, 0.005)
+        ):
+            notes.append(
+                "WARNING: serve_shed_rate rose >2x vs baseline: "
+                f"candidate {candidate.serve_shed_rate:g} vs best "
+                f"{best_shed:g} — reported only (warn, not fail); the "
+                "churn leg is shedding queries the baseline answered — "
+                "check query_p99 before the next round"
+            )
+    budget_base = [
+        r.serve_slo_budget_remaining
+        for r in baselines
+        if isinstance(r.serve_slo_budget_remaining, (int, float))
+    ]
+    if budget_base and isinstance(
+        candidate.serve_slo_budget_remaining, (int, float)
+    ):
+        best_budget = max(budget_base)
+        if candidate.serve_slo_budget_remaining < best_budget / 2.0:
+            notes.append(
+                "WARNING: serve_slo_budget_remaining sank >2x vs "
+                f"baseline: candidate "
+                f"{candidate.serve_slo_budget_remaining:g} vs best "
+                f"{best_budget:g} — reported only (warn, not fail); "
+                "the query_p99 error budget is burning faster under "
+                "the same churn workload"
+            )
 
     # --- precedence-tier leg: WARN, never fail --------------------------
     # same discipline as serve: the leg's oracle spot-parity assertion

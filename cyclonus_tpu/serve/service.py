@@ -29,7 +29,6 @@ empty.  Every Verdict carries the epoch it was computed at.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -41,13 +40,17 @@ from ..engine.api import PortCase, TpuPolicyEngine, _parseable_ip
 from ..kube.netpol import NAMESPACE_DEFAULT, NetworkPolicy
 from ..kube.yaml_io import parse_policy_dict
 from ..matcher.builder import build_network_policies
+from ..slo.engine import SloController
 from ..telemetry import instruments as ti
+# graduated to telemetry.metrics (now interpolates inside the winning
+# bucket); re-exported here for compatibility
+from ..telemetry.metrics import histogram_quantile  # noqa: F401
 from ..tiers.model import (
     AdminNetworkPolicy,
     BaselineAdminNetworkPolicy,
     TierSet,
 )
-from ..utils import guards
+from ..utils import envflags, guards
 from ..utils.tracing import phase
 from ..worker.model import Delta, FlowQuery, Verdict
 from .incremental import (
@@ -66,53 +69,25 @@ VERIFY_CASES = (
 
 
 def _churn_row_limit() -> int:
-    try:
-        return int(os.environ.get("CYCLONUS_SERVE_CHURN_ROWS", "64"))
-    except ValueError:
-        return 64
+    return envflags.get_int("CYCLONUS_SERVE_CHURN_ROWS")
 
 
 def _churn_frac_limit() -> float:
-    try:
-        return float(os.environ.get("CYCLONUS_SERVE_CHURN_FRAC", "0.25"))
-    except ValueError:
-        return 0.25
+    return envflags.get_float("CYCLONUS_SERVE_CHURN_FRAC")
 
 
 def _prewarm_pair_cap() -> int:
     """Largest power-of-two pair bucket prewarm compiles (the query
     path pads batches to pow2, so buckets 1..cap cover every batch up
     to cap).  CYCLONUS_SERVE_PREWARM_PAIRS overrides; default 64."""
-    try:
-        return int(os.environ.get("CYCLONUS_SERVE_PREWARM_PAIRS", "64"))
-    except ValueError:
-        return 64
+    return envflags.get_int("CYCLONUS_SERVE_PREWARM_PAIRS")
 
 
-def histogram_quantile(snapshot: Dict, q: float) -> Optional[float]:
-    """Approximate quantile from a telemetry Histogram snapshot (upper
-    bucket bound of the bucket holding the q-th sample, merged across
-    label series) — good enough for the p50/p99 surfaces /state and the
-    bench detail report."""
-    samples = snapshot.get("samples") or []
-    buckets = snapshot.get("buckets") or []
-    if not samples or not buckets:
-        return None
-    counts = [0] * len(buckets)
-    total = 0
-    for s in samples:
-        for i, c in enumerate(s.get("counts") or []):
-            counts[i] += c
-            total += c
-    if total == 0:
-        return None
-    rank = q * total
-    cum = 0
-    for ub, c in zip(buckets, counts):
-        cum += c
-        if cum >= rank:
-            return float(ub)
-    return float(buckets[-1])
+class AdmissionRejected(Exception):
+    """submit() refusal under freshness-budget admission control
+    (CYCLONUS_SLO_ENFORCE): the delta batch was NOT enqueued; str(e) is
+    the reason the SLO controller gave.  The wire loop reports it in
+    the reply envelope, HTTP maps it to 429."""
 
 
 def register_http(service: "VerdictService") -> None:
@@ -123,6 +98,9 @@ def register_http(service: "VerdictService") -> None:
                                       staleness seconds, apply counters
         /query?src=x/a&dst=y/b&port=80&protocol=TCP[&portName=...]
                                       one curl-able flow verdict
+                                      (429 when the query was shed)
+        /slo                          per-objective budget remaining,
+                                      burn rates, enforcement state
     """
     from ..telemetry import server as tserver
 
@@ -149,10 +127,13 @@ def register_http(service: "VerdictService") -> None:
             port_name=one("portName"),
         )
         verdict = service.query([fq])[0]
+        if verdict.shed:
+            return verdict.to_dict(), 429  # typed refusal, not an answer
         return verdict.to_dict(), (400 if verdict.error else 200)
 
     tserver.register_route("/state", state_route)
     tserver.register_route("/query", query_route)
+    tserver.register_slo(service.slo_snapshot)
 
 
 @guards.checked
@@ -180,8 +161,16 @@ class VerdictService:
         class_compress: Optional[str] = None,
         tiers: Optional[TierSet] = None,
         defer_ready: bool = False,
+        slo: Optional[SloController] = None,
     ):
         self._lock = guards.lock()
+        # SLO controller (cyclonus_tpu/slo): created at construction so
+        # its clock anchors time-to-first-verdict at boot.  Accounting
+        # rides the scrape-time collector below; enforcement reads are
+        # lock-cheap on submit()/query().  Lock order: service._lock ->
+        # slo._lock (never the reverse — tick runs after this lock is
+        # released).
+        self._slo = slo or SloController()
         # readiness (docs/DESIGN.md "Cold start & chaos"): warming is
         # not ready.  A thread-safe Event, not a Guarded field — the
         # /readyz callback and the query router read it lock-free while
@@ -289,12 +278,24 @@ class VerdictService:
 
     def submit(self, deltas: Sequence[Delta]) -> int:
         """Enqueue deltas; returns the pending depth.  Cheap by design —
-        the wire loop can acknowledge intake before paying the apply."""
+        the wire loop can acknowledge intake before paying the apply.
+
+        Admission control (CYCLONUS_SLO_ENFORCE): while the freshness
+        error budget is burning the pending queue is capped, and with
+        the budget exhausted intake is rejected outright — raising
+        AdmissionRejected WITHOUT enqueueing, so back-pressure reaches
+        the delta source instead of silently growing staleness."""
+        depth = 0
         with self._lock:
-            if deltas and self._pending_since is None:
-                self._pending_since = time.monotonic()
-            self._queue.extend(deltas)
-            depth = len(self._queue)
+            reason = self._slo.admit(len(self._queue), len(deltas))
+            if reason is None:
+                if deltas and self._pending_since is None:
+                    self._pending_since = time.monotonic()
+                self._queue.extend(deltas)
+                depth = len(self._queue)
+        if reason is not None:
+            ti.SLO_ADMISSION_REJECTS.inc()
+            raise AdmissionRejected(reason)
         ti.SERVE_PENDING.set(depth)
         ti.SERVE_DELTAS.inc(len(deltas))
         return depth
@@ -758,12 +759,26 @@ class VerdictService:
         flight), queries answer from the scalar-oracle authoritative-
         state fallback instead — exact verdicts at host speed, counted
         in cyclonus_tpu_serve_degraded_queries_total — so a fleet
-        router that ignores /readyz still gets correct answers."""
+        router that ignores /readyz still gets correct answers.
+
+        SLO enforcement (CYCLONUS_SLO_ENFORCE) routes ahead of the
+        warming check: query_p99 budget EXHAUSTED sheds the batch with
+        typed refusals (never a wrong verdict — shed answers carry
+        shed=True plus an error, so nothing can read their False
+        allow-bits as a deny); BURNING routes onto the same scalar-
+        oracle degraded path warming uses, trading device latency under
+        overload for host-speed exact answers."""
         from ..engine import planspec
 
-        if not self._ready.is_set():
+        route = self._slo.query_route()
+        if route == "shed":
+            planspec.record("serve.query.shed")
+            return self._query_shed(queries)
+        if not self._ready.is_set() or route == "degraded":
             planspec.record("serve.query.degraded")
-            return self._query_degraded(queries)
+            out = self._query_degraded(queries)
+            self._slo.note_first_verdict()
+            return out
         planspec.record("serve.query.live")
         t0 = time.perf_counter()
         with self._lock:
@@ -781,6 +796,7 @@ class VerdictService:
         for _ in range(len(queries)):
             ti.SERVE_QUERY_LATENCY.observe(per)
         ti.SERVE_QUERIES.inc(len(queries))
+        self._slo.note_first_verdict()
         return [v for v in out if v is not None]
 
     @guards.holds("self._lock")
@@ -879,6 +895,29 @@ class VerdictService:
         ti.SERVE_DEGRADED.inc(len(queries))
         return out
 
+    def _query_shed(self, queries: Sequence[FlowQuery]) -> List[Verdict]:
+        """Load-shed refusal: every query in the batch gets a typed
+        Shed verdict — shed=True AND an error, so a caller that ignores
+        the new field still sees a non-answer (the allow-bits stay at
+        their False defaults and MUST NOT be read; the error guards
+        that).  No engine work, no latency observation — shed exists to
+        take work OFF the device while the query_p99 budget recovers."""
+        epoch = self.epoch
+        out = [
+            Verdict(
+                query=q,
+                epoch=epoch,
+                shed=True,
+                error=(
+                    "shed: query_p99 error budget exhausted; retry "
+                    "after the budget recovers (/slo)"
+                ),
+            )
+            for q in queries
+        ]
+        ti.SLO_SHED.inc(len(queries))
+        return out
+
     # --- observability ----------------------------------------------------
 
     def _refresh_gauges(self) -> None:
@@ -889,8 +928,18 @@ class VerdictService:
         Try-locks with a short timeout: apply_pending can hold the lock
         for a full rebuild (minutes over a tunneled chip), and a scrape
         landing in that window must keep /metrics responsive — it skips
-        the refresh and the last written values stand."""
+        the refresh and the last written values stand (counted in
+        cyclonus_tpu_serve_gauge_refresh_skipped_total, so that
+        staleness-of-staleness is itself observable).
+
+        Doubles as the SLO accounting cadence: every scrape advances
+        the burn-rate accountants (slo.tick AFTER the service lock is
+        released — lock order service -> slo holds).  A contended skip
+        still ticks latency accounting; only the freshness sample is
+        missing that tick."""
         if not self._lock.acquire(timeout=0.2):
+            ti.SERVE_GAUGE_REFRESH_SKIPPED.inc()
+            self._slo.tick()
             return
         try:
             pending = len(self._queue)
@@ -905,6 +954,7 @@ class VerdictService:
         ti.SERVE_PENDING.set(pending)
         ti.SERVE_STALENESS.set(staleness)
         ti.SERVE_EPOCH.set(epoch)
+        self._slo.tick(staleness_s=staleness)
 
     def state(self) -> Dict:
         """The /state payload: epoch, pending-delta depth, staleness
@@ -946,7 +996,27 @@ class VerdictService:
                     "p50_s": histogram_quantile(hist, 0.50),
                     "p99_s": histogram_quantile(hist, 0.99),
                 },
+                "slo": {
+                    "enforce": self._slo.enforce,
+                    "objectives": {
+                        name: {
+                            "state": o["state"],
+                            "budget_remaining": o["budget_remaining"],
+                        }
+                        for name, o in
+                        self._slo.snapshot()["objectives"].items()
+                    },
+                },
             }
+
+    @property
+    def slo(self) -> SloController:
+        """The service's SLO controller (tests, drills, harnesses)."""
+        return self._slo
+
+    def slo_snapshot(self) -> Dict:
+        """The /slo payload (telemetry/server.py register_slo)."""
+        return self._slo.snapshot()
 
     # --- the differential correctness gate --------------------------------
 

@@ -313,6 +313,50 @@ SERVE_APPLY_SECONDS = REGISTRY.histogram(
     "Verdict service: delta-apply spans, by mode.",
     labelnames=("mode",),
 )
+SERVE_GAUGE_REFRESH_SKIPPED = REGISTRY.counter(
+    "cyclonus_tpu_serve_gauge_refresh_skipped_total",
+    "Verdict service: scrape-time gauge refreshes skipped because the "
+    "service lock was contended past the try-lock timeout — nonzero "
+    "means /metrics pending/staleness values are themselves stale.",
+)
+
+# --- SLO engine (cyclonus_tpu/slo) ----------------------------------------
+
+SLO_BURN_RATE = REGISTRY.gauge(
+    "cyclonus_tpu_slo_burn_rate",
+    "SLO engine: error-budget burn rate per objective and window "
+    "(1.0 = budget spent exactly as fast as it accrues).",
+    labelnames=("objective", "window"),
+)
+SLO_BUDGET_REMAINING = REGISTRY.gauge(
+    "cyclonus_tpu_slo_budget_remaining",
+    "SLO engine: fraction of the slow-window error budget left per "
+    "objective, in [0, 1] (0 = exhausted).",
+    labelnames=("objective",),
+)
+SLO_STATE = REGISTRY.gauge(
+    "cyclonus_tpu_slo_enforcement_state",
+    "SLO engine: enforcement state per objective (0 ok / 1 burning / "
+    "2 exhausted).",
+    labelnames=("objective",),
+)
+SLO_BREACHES = REGISTRY.counter(
+    "cyclonus_tpu_slo_breaches_total",
+    "SLO engine: budget-exhaustion transitions (each one dumps the "
+    "flight recorder with reason slo-breach:<objective>).",
+    labelnames=("objective",),
+)
+SLO_SHED = REGISTRY.counter(
+    "cyclonus_tpu_slo_shed_queries_total",
+    "SLO engine: flow queries refused with a typed Shed verdict while "
+    "the query_p99 budget was exhausted (never a wrong verdict — a "
+    "shed is distinguishable from allow/deny).",
+)
+SLO_ADMISSION_REJECTS = REGISTRY.counter(
+    "cyclonus_tpu_slo_admission_rejects_total",
+    "SLO engine: delta batches refused at submit() by freshness-budget "
+    "admission control.",
+)
 
 # --- real-probe latency --------------------------------------------------
 

@@ -363,4 +363,37 @@ class MetricRegistry:
                         m._series[()] = m._zero()
 
 
+def histogram_quantile(snapshot: Dict, q: float) -> Optional[float]:
+    """Quantile estimate from a Histogram snapshot, label series
+    merged.  Linearly interpolates inside the winning bucket (the
+    Prometheus histogram_quantile() estimator) instead of reporting the
+    bucket's upper bound, so tight latency targets between bucket edges
+    still produce a moving p99.  The first bucket interpolates from 0;
+    a rank landing past the last finite bucket clamps to its bound."""
+    samples = snapshot.get("samples") or []
+    buckets = snapshot.get("buckets") or []
+    if not samples or not buckets:
+        return None
+    counts = [0] * len(buckets)
+    total = 0
+    for s in samples:
+        for i, c in enumerate(s.get("counts") or []):
+            counts[i] += c
+            total += c
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, (ub, c) in enumerate(zip(buckets, counts)):
+        prev_cum = cum
+        cum += c
+        if cum >= rank:
+            lo = 0.0 if i == 0 else float(buckets[i - 1])
+            if c <= 0:
+                return float(ub)
+            frac = (rank - prev_cum) / c
+            return lo + (float(ub) - lo) * min(1.0, max(0.0, frac))
+    return float(buckets[-1])
+
+
 REGISTRY = MetricRegistry()

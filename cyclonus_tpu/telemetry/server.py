@@ -18,6 +18,12 @@ it) and serves, on a daemon thread:
                        traffic while a replica warms; with no callback
                        registered, readiness == liveness (the old
                        single-answer behavior)
+    /slo               SLO engine snapshot (cyclonus_tpu/slo): per-
+                       objective budget remaining, burn rates, and
+                       enforcement state as JSON, from the provider
+                       registered via register_slo() — 503 until a
+                       provider registers (serve wires its controller
+                       here)
 
 Extension routes registered via `register_route(path, fn)` serve JSON
 from the same thread — `cyclonus-tpu serve` adds /state (engine epoch,
@@ -107,6 +113,33 @@ def _readiness() -> tuple:
         return False, f"readiness callback failed: {type(e).__name__}: {e}"
 
 
+# optional SLO snapshot provider: fn() -> dict (the /slo payload — per-
+# objective budget remaining, burn rates, enforcement state; see
+# cyclonus_tpu/slo).  Built-in route so /slo sits next to /metrics and
+# /readyz on every process that has a provider; without one it answers
+# 503 (the surface exists, the engine just isn't wired), mirroring the
+# register_readiness pattern.
+_SLO: dict = {"fn": None}  # guarded-by: _ROUTES_LOCK
+
+
+def register_slo(fn) -> None:
+    """Register the process SLO snapshot provider (replaces any
+    previous one; None unregisters)."""
+    with _ROUTES_LOCK:
+        _SLO["fn"] = fn
+
+
+def _slo_payload() -> tuple:
+    with _ROUTES_LOCK:
+        fn = _SLO["fn"]
+    if fn is None:
+        return {"error": "no slo provider registered"}, 503
+    try:
+        return dict(fn()), 200
+    except Exception as e:  # a broken provider must answer, not hang
+        return {"error": f"slo provider failed: {type(e).__name__}: {e}"}, 500
+
+
 class _Handler(BaseHTTPRequestHandler):
     def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
         self.send_response(code)
@@ -149,6 +182,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "text/plain",
                 200 if ready else 503,
             )
+        elif path == "/slo":
+            payload, code = _slo_payload()
+            self._send_json(payload, code)
         else:
             fn = _route_for(path)
             if fn is None:

@@ -38,7 +38,7 @@ class Flag:
     name: str
     kind: str  # "bool" | "int" | "float" | "enum" | "str" | "path"
     default: object
-    owner: str  # "engine" | "serve" | "worker" | "chaos" | "telemetry" | "probe" | "harness" | "cli"
+    owner: str  # "engine" | "serve" | "worker" | "chaos" | "telemetry" | "probe" | "harness" | "cli" | "slo"
     description: str
     choices: Tuple[str, ...] = field(default=())
 
@@ -158,6 +158,36 @@ _FLAGS = [
          "Flight-recorder dump path ('' picks the default)."),
     Flag("CYCLONUS_FLIGHT_RECORDER_N", "int", 64, "telemetry",
          "Flight-recorder ring capacity."),
+    # --- slo: objectives, windows, and enforcement ----------------------
+    Flag("CYCLONUS_SLO_QUERY_P99_S", "float", 0.25, "slo",
+         "query_p99 objective target: per-flow query latency bound."),
+    Flag("CYCLONUS_SLO_FRESHNESS_S", "float", 5.0, "slo",
+         "freshness objective target: oldest pending delta's tolerated "
+         "wait age."),
+    Flag("CYCLONUS_SLO_TTFV_S", "float", 150.0, "slo",
+         "ttfv objective target: time-to-first-verdict after restart."),
+    Flag("CYCLONUS_SLO_BUDGET", "float", 0.01, "slo",
+         "Error budget shared by the declared objectives (tolerated "
+         "bad-event fraction)."),
+    Flag("CYCLONUS_SLO_FAST_S", "float", 300.0, "slo",
+         "Fast burn-rate window (seconds)."),
+    Flag("CYCLONUS_SLO_SLOW_S", "float", 3600.0, "slo",
+         "Slow burn-rate window (seconds)."),
+    Flag("CYCLONUS_SLO_ENFORCE", "bool", False, "slo",
+         "Arm SLO enforcement (admission control, shed, degraded-path "
+         "governance); accounting and /slo run regardless."),
+    Flag("CYCLONUS_SLO_QUEUE_CAP", "int", 1024, "slo",
+         "Pending-delta queue cap applied while the freshness budget "
+         "is burning."),
+    Flag("CYCLONUS_SLO_ENTER_BURN", "float", 2.0, "slo",
+         "Fast-window burn rate at which an objective enters "
+         "'burning'."),
+    Flag("CYCLONUS_SLO_EXIT_BURN", "float", 1.0, "slo",
+         "Burn rate both windows must stay below to start the exit "
+         "hold."),
+    Flag("CYCLONUS_SLO_HOLD_S", "float", 60.0, "slo",
+         "Continuous below-exit-threshold time required to leave an "
+         "enforcement state."),
     # --- harnesses (strip contracts: read ONCE at import) ---------------
     Flag("CYCLONUS_SHAPE_CHECK", "bool", False, "harness",
          "Arm runtime shape-contract checks (utils/contracts.py)."),

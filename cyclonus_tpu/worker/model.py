@@ -25,6 +25,10 @@ directions are asserted by tests/test_worker.py):
     SAME envelope, and the service answers with Verdict dicts.  An old
     worker receiving a serve batch simply ignores the unknown keys and
     probes the (empty) Requests list; an old driver never emits them.
+    Verdict.Shed (cyclonus_tpu/slo) marks a load-shed refusal: emitted
+    only when True, always alongside Error, so a pre-SLO consumer sees
+    an ordinary error-verdict and never misreads the all-False allow
+    bits as a deny.
 """
 
 from __future__ import annotations
@@ -213,7 +217,14 @@ class Verdict:
     back (responses may be reordered relative to a batch), the three
     allow bits, and the engine epoch the answer was computed at (the
     staleness anchor).  A query the engine cannot answer (unknown pod
-    key, bad protocol) carries Error and all-False bits."""
+    key, bad protocol) carries Error and all-False bits.
+
+    Shed is the SLO engine's typed refusal (optional, omitted when
+    False — pre-SLO peers never see it): the service declined to
+    answer because the query-latency error budget was exhausted.  A
+    shed verdict also carries Error, so a caller that predates the
+    field still treats it as a non-answer rather than reading the
+    all-False bits as a deny."""
 
     WIRE: ClassVar[Dict[str, contracts.WireField]] = {
         "Query": contracts.wire(dict),
@@ -223,6 +234,7 @@ class Verdict:
         "Epoch": contracts.wire(int, optional=True),
         "Error": contracts.wire(str, optional=True),
         "LatencyMs": contracts.wire(float, optional=True),
+        "Shed": contracts.wire(bool, optional=True),
     }
 
     query: FlowQuery
@@ -232,6 +244,7 @@ class Verdict:
     epoch: Optional[int] = None
     error: str = ""
     latency_ms: Optional[float] = None
+    shed: bool = False
 
     def to_dict(self) -> dict:
         d: Dict[str, Any] = {
@@ -246,6 +259,8 @@ class Verdict:
             d["Error"] = self.error
         if self.latency_ms is not None:
             d["LatencyMs"] = self.latency_ms
+        if self.shed:
+            d["Shed"] = True
         if contracts.CHECK:
             contracts.check_wire("Verdict", d, self.WIRE)
         return d
@@ -263,6 +278,7 @@ class Verdict:
             epoch=d.get("Epoch"),
             error=d.get("Error", "") or "",
             latency_ms=float(latency) if latency is not None else None,
+            shed=bool(d.get("Shed", False)),
         )
 
 

@@ -467,7 +467,22 @@ def scenario_serve_routes(ctx: Ctx) -> Dict:
         "serve.query.live",
         f"live query routed {live}",
     )
-    return {"routes": 2}
+    # shed: pin the query_p99 objective exhausted on an armed controller
+    # and the same query comes back as a typed refusal route
+    from cyclonus_tpu.slo import EXHAUSTED, SloController
+
+    svc2 = VerdictService(pods, namespaces, [], slo=SloController(enforce=True))
+    svc2.slo.force_state("query_p99", EXHAUSTED)
+    ctx.drain()
+    out = svc2.query(queries)
+    shed = ctx.drain()
+    _check(
+        all(v.shed for v in out)
+        and shed[:1] == [planspec.predict("serve_query", {"shed": True})],
+        "serve.query.shed",
+        f"exhausted query routed {shed}",
+    )
+    return {"routes": 3}
 
 
 def scenario_ring_pipelined_route(ctx: Ctx) -> Dict:

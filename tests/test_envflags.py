@@ -58,7 +58,7 @@ class TestRegistryCompleteness:
             assert flag.kind in ("bool", "int", "float", "enum", "str", "path")
             assert flag.owner in (
                 "engine", "serve", "worker", "chaos", "telemetry",
-                "probe", "harness", "cli",
+                "probe", "harness", "cli", "slo",
             )
             assert flag.description
             if flag.kind == "enum":

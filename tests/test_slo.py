@@ -1,0 +1,710 @@
+"""SLO engine tests (cyclonus_tpu/slo): burn-rate math against
+synthetic event/histogram streams with KNOWN budget-exhaustion
+instants, hysteresis entry/exit (eager entry, held exit, no flap),
+the pinned `cyclonus_tpu_slo_*` gauge names and /slo JSON shape, the
+breach black-box dump, and enforcement in the verdict service —
+admission control on submit(), shed on query() with the differential
+gate extended to the shed path (a non-shed answer is bit-identical to
+an unenforced twin; a shed answer is a typed refusal, never a wrong
+verdict)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cyclonus_tpu.slo import (
+    BURNING,
+    EXHAUSTED,
+    OK,
+    BurnAccountant,
+    Hysteresis,
+    Objective,
+    SloController,
+    declared_objectives,
+    events_over_target,
+    state_severity,
+)
+from cyclonus_tpu.slo.objectives import GAUGE, HISTOGRAM, ONCE
+from cyclonus_tpu.telemetry import instruments as ti
+
+
+def synth_hist(good: int, bad: int, buckets=(0.05, 0.2)):
+    """A telemetry Histogram snapshot with `good` events in the first
+    bucket and `bad` in the second (cumulative totals — callers feed a
+    monotone stream of these)."""
+    return {
+        "type": "histogram",
+        "help": "synthetic",
+        "buckets": list(buckets),
+        "samples": [{
+            "labels": {},
+            "counts": [good, bad],
+            "sum": 0.0,
+            "count": good + bad,
+        }],
+    }
+
+
+def mk_objective(
+    name="query_p99",
+    kind=HISTOGRAM,
+    target_s=0.1,
+    budget=0.25,
+    fast_s=5.0,
+    slow_s=10.0,
+):
+    return Objective(
+        name=name, kind=kind, signal="synthetic", target_s=target_s,
+        budget=budget, fast_s=fast_s, slow_s=slow_s, enforces="test",
+        description="synthetic objective",
+    )
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class TestBurnAccounting:
+    """Pure window math: cumulative (total, bad) streams in, burn
+    rates and budget remaining out, at pinned instants."""
+
+    def test_burn_rates_and_remaining(self):
+        acct = BurnAccountant(budget=0.1, fast_s=5.0, slow_s=10.0)
+        acct.observe(0.0, 0.0, 0.0)
+        assert acct.burn_rates(0.0) == (0.0, 0.0)
+        assert acct.budget_remaining(0.0) == 1.0
+        acct.observe(1.0, 100.0, 0.0)
+        assert acct.burn_rates(1.0) == (0.0, 0.0)
+        # 4 bad of 4 new events inside the fast window; the slow window
+        # still sees the 100 good ones
+        acct.observe(8.0, 104.0, 4.0)
+        fast, slow = acct.burn_rates(8.0)
+        assert fast == pytest.approx((4 / 4) / 0.1)  # window (3, 8]
+        assert slow == pytest.approx((4 / 104) / 0.1)
+        assert acct.budget_remaining(8.0) == pytest.approx(
+            1.0 - (4 / 104) / 0.1
+        )
+
+    def test_stream_younger_than_window_counts_everything(self):
+        acct = BurnAccountant(budget=0.5, fast_s=5.0, slow_s=10.0)
+        acct.observe(1.0, 10.0, 5.0)
+        assert acct.bad_fraction(1.0, 10.0) == pytest.approx(0.5)
+
+    def test_backwards_stream_resets_the_window(self):
+        """A registry reset between ticks must restart accounting, not
+        produce negative deltas."""
+        acct = BurnAccountant(budget=0.1, fast_s=5.0, slow_s=10.0)
+        acct.observe(0.0, 100.0, 50.0)
+        acct.observe(1.0, 10.0, 0.0)  # totals moved backwards
+        assert acct.bad_fraction(1.0, 10.0) == 0.0
+
+    def test_pruning_keeps_a_baseline_past_the_slow_window(self):
+        acct = BurnAccountant(budget=0.1, fast_s=2.0, slow_s=4.0)
+        for t in range(12):
+            acct.observe(float(t), float(t * 10), 0.0)
+        # one sample at-or-before now-slow_s survives as the diff base
+        assert acct._samples[0].at <= 11.0 - 4.0
+        assert acct._samples[1].at > 11.0 - 4.0
+        assert acct.bad_fraction(11.0, 4.0) == 0.0
+
+    def test_budget_remaining_clamps(self):
+        acct = BurnAccountant(budget=0.01, fast_s=5.0, slow_s=10.0)
+        acct.observe(1.0, 100.0, 100.0)
+        assert acct.budget_remaining(1.0) == 0.0  # not negative
+
+
+class TestHysteresis:
+    """Entry/exit discipline: eager entry on the fast window, exhausted
+    on zero remaining, exit only after a continuous below-exit hold."""
+
+    def test_fast_entry_and_exhausted_ordering(self):
+        h = Hysteresis(enter_burn=2.0, exit_burn=1.0, hold_s=2.0)
+        assert h.update(0.0, 0.5, 0.1, 0.9) == OK
+        assert h.update(1.0, 2.0, 0.2, 0.8) == BURNING  # fast-window entry
+        assert h.since == 1.0
+        assert h.update(2.0, 9.0, 0.9, 0.1) == BURNING
+        assert h.update(3.0, 9.0, 1.5, 0.0) == EXHAUSTED
+        assert h.since == 3.0
+
+    def test_exhausted_direct_from_ok(self):
+        h = Hysteresis(enter_burn=2.0, exit_burn=1.0, hold_s=2.0)
+        assert h.update(0.0, 0.5, 2.0, 0.0) == EXHAUSTED
+
+    def test_exit_needs_a_continuous_hold(self):
+        h = Hysteresis(enter_burn=2.0, exit_burn=1.0, hold_s=2.0)
+        h.update(0.0, 3.0, 0.5, 0.5)
+        assert h.state == BURNING
+        assert h.update(1.0, 0.2, 0.2, 0.9) == BURNING  # hold starts
+        assert h.update(2.0, 0.2, 0.2, 0.9) == BURNING  # 1s < hold
+        assert h.update(3.0, 0.2, 0.2, 0.9) == OK       # 2s >= hold
+
+    def test_oscillation_resets_the_hold(self):
+        """The anti-flap contract: dipping below exit then bouncing
+        back above it restarts the hold clock."""
+        h = Hysteresis(enter_burn=2.0, exit_burn=1.0, hold_s=2.0)
+        h.update(0.0, 3.0, 0.5, 0.5)
+        h.update(1.0, 0.5, 0.5, 0.9)   # below exit: hold starts
+        h.update(2.0, 1.5, 0.5, 0.9)   # above exit again: hold resets
+        assert h.state == BURNING
+        h.update(3.0, 0.5, 0.5, 0.9)
+        assert h.update(4.0, 0.5, 0.5, 0.9) == BURNING  # only 1s held
+        assert h.update(5.0, 0.5, 0.5, 0.9) == OK
+        assert h.transitions == 2  # ok->burning, burning->ok
+
+    def test_middle_zone_keeps_state(self):
+        """Between exit and enter nothing moves: no upgrade, no hold."""
+        h = Hysteresis(enter_burn=2.0, exit_burn=1.0, hold_s=1.0)
+        assert h.update(0.0, 1.5, 0.5, 0.9) == OK
+        h.update(1.0, 3.0, 0.5, 0.5)
+        for t in range(2, 10):
+            assert h.update(float(t), 1.5, 0.5, 0.9) == BURNING
+
+    def test_state_severity(self):
+        assert [state_severity(s) for s in (OK, BURNING, EXHAUSTED)] == [
+            0, 1, 2,
+        ]
+
+
+class TestEventsOverTarget:
+    def test_bucket_split(self):
+        ev = events_over_target(synth_hist(30, 12), target_s=0.1)
+        assert ev == {"total": 42.0, "bad": 12.0}
+
+    def test_merges_label_series(self):
+        snap = synth_hist(10, 2)
+        snap["samples"].append(
+            {"labels": {"k": "v"}, "counts": [5, 3], "sum": 0.0, "count": 8}
+        )
+        assert events_over_target(snap, 0.1) == {"total": 20.0, "bad": 5.0}
+
+    def test_empty(self):
+        assert events_over_target({"buckets": [], "samples": []}, 0.1) == {
+            "total": 0.0, "bad": 0.0,
+        }
+
+
+class TestControllerTimeline:
+    """The controller against a synthetic histogram stream with pinned
+    transition instants: burning at t=9, exhausted at t=11, recovered
+    to ok at t=23 (slow window drained + 2s hold)."""
+
+    def mk(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "CYCLONUS_FLIGHT_RECORDER_PATH", str(tmp_path / "breach.json")
+        )
+        clock = FakeClock()
+        ctl = SloController(
+            [mk_objective(budget=0.25, fast_s=5.0, slow_s=10.0)],
+            enforce=True, queue_cap=4, clock=clock,
+        )
+        # enter 2.0 / exit 1.0 from defaults; shrink the hold
+        ctl._trackers["query_p99"].hyst.hold_s = 2.0
+        return ctl, clock
+
+    def feed(self, ctl, t, good, bad):
+        ctl.tick(latency_snapshot=synth_hist(good, bad), now=float(t))
+        return ctl.state_of("query_p99")
+
+    def test_pinned_transition_instants(self, tmp_path, monkeypatch):
+        from cyclonus_tpu.telemetry import recorder
+
+        recorder.reset()
+        ctl, _clock = self.mk(tmp_path, monkeypatch)
+        assert self.feed(ctl, 0, 0, 0) == OK
+        assert self.feed(ctl, 1, 1000, 0) == OK
+        # t=9: 30 bad of 30 events inside the 5s fast window -> fast
+        # burn 4.0 >= enter; the slow window still sees the good 1000
+        # (burn 0.12) so the budget holds: BURNING, route = degraded
+        assert self.feed(ctl, 9, 1000, 30) == BURNING
+        assert ctl.query_route() == "degraded"
+        assert ctl.snapshot()["objectives"]["query_p99"]["state"] == BURNING
+        # t=10: more bad, budget still > 0
+        assert self.feed(ctl, 10, 1000, 130) == BURNING
+        # t=11: slow-window bad fraction 330/360 -> burn 3.67 -> the
+        # budget is spent: EXHAUSTED, route = shed, black box dumped
+        assert self.feed(ctl, 11, 1000, 330) == EXHAUSTED
+        assert ctl.query_route() == "shed"
+        assert ti.SLO_BREACHES.value(objective="query_p99") >= 1
+        dump = json.loads((tmp_path / "breach.json").read_text())
+        assert dump["reason"] == "slo-breach:query_p99"
+        entry = [
+            e for e in dump["entries"] if e.get("path") == "slo.breach"
+        ][-1]
+        assert entry["objective"] == "query_p99"
+        assert "trace_id" in entry and "span_path" in entry
+        # recovery: the stream stops (constant cumulative totals).  The
+        # slow window drains at t=21; the 2s hold keeps the state
+        # EXHAUSTED until t=23 — no flap on the way out.
+        for t in range(12, 23):
+            assert self.feed(ctl, t, 1000, 330) == EXHAUSTED, t
+        assert self.feed(ctl, 23, 1000, 330) == OK
+        assert ctl.query_route() == "live"
+        snap = ctl.snapshot()["objectives"]["query_p99"]
+        assert snap["budget_remaining"] == 1.0
+
+    def test_tick_never_raises(self, tmp_path, monkeypatch):
+        ctl, _ = self.mk(tmp_path, monkeypatch)
+        ctl.tick(latency_snapshot={"buckets": "garbage"}, now=1.0)
+
+    def test_gauge_objective_counts_threshold_crossings(self):
+        clock = FakeClock()
+        ctl = SloController(
+            [mk_objective(name="freshness", kind=GAUGE, target_s=5.0,
+                          budget=0.5, fast_s=5.0, slow_s=10.0)],
+            enforce=True, clock=clock,
+        )
+        empty = synth_hist(0, 0)
+        for t in range(4):
+            ctl.tick(staleness_s=1.0, latency_snapshot=empty, now=float(t))
+        assert ctl.state_of("freshness") == OK
+        for t in range(4, 8):
+            ctl.tick(staleness_s=60.0, latency_snapshot=empty, now=float(t))
+        # 4 of 8 ticks over target = bad fraction 0.5 = burn 1.0 -> the
+        # 0.5 budget is spent
+        assert ctl.state_of("freshness") == EXHAUSTED
+        assert ctl.admit(0, 1) is not None
+
+    def test_contended_tick_skips_only_the_freshness_sample(self):
+        clock = FakeClock()
+        ctl = SloController(
+            [mk_objective(name="freshness", kind=GAUGE, target_s=5.0,
+                          budget=0.5, fast_s=5.0, slow_s=10.0)],
+            enforce=False, clock=clock,
+        )
+        ctl.tick(staleness_s=10.0, latency_snapshot=synth_hist(0, 0), now=1.0)
+        n = len(ctl._trackers["freshness"].acct._samples)
+        ctl.tick(latency_snapshot=synth_hist(0, 0), now=2.0)  # contended
+        assert len(ctl._trackers["freshness"].acct._samples) == n
+        assert ctl.snapshot()["ticks"] == 2
+
+
+class TestTtfv:
+    def test_within_target_stays_ok(self):
+        ctl = SloController(
+            [mk_objective(name="ttfv", kind=ONCE, target_s=100.0)],
+            enforce=True, clock=FakeClock(5.0),
+        )
+        ctl.observe_ttfv(3.0, now=6.0)
+        assert ctl.state_of("ttfv") == OK
+
+    def test_over_target_breaches_and_dumps(self, tmp_path, monkeypatch):
+        from cyclonus_tpu.telemetry import recorder
+
+        recorder.reset()
+        monkeypatch.setenv(
+            "CYCLONUS_FLIGHT_RECORDER_PATH", str(tmp_path / "ttfv.json")
+        )
+        ctl = SloController(
+            [mk_objective(name="ttfv", kind=ONCE, target_s=0.001)],
+            enforce=True, clock=FakeClock(5.0),
+        )
+        ctl.observe_ttfv(7.5, now=6.0)
+        assert ctl.state_of("ttfv") == EXHAUSTED
+        dump = json.loads((tmp_path / "ttfv.json").read_text())
+        assert dump["reason"] == "slo-breach:ttfv"
+        entry = [
+            e for e in dump["entries"] if e.get("path") == "slo.breach"
+        ][-1]
+        assert entry["ttfv_s"] == 7.5
+
+    def test_note_first_verdict_is_idempotent(self):
+        clock = FakeClock(0.0)
+        ctl = SloController(
+            [mk_objective(name="ttfv", kind=ONCE, target_s=100.0)],
+            enforce=True, clock=clock,
+        )
+        clock.t = 3.0
+        ctl.note_first_verdict()
+        tr = ctl._trackers["ttfv"]
+        assert [s.total for s in tr.acct._samples] == [1.0]
+        clock.t = 50.0
+        ctl.note_first_verdict()  # later calls must not re-observe
+        assert [s.total for s in tr.acct._samples] == [1.0]
+
+
+class TestEnforcementDecisions:
+    def test_disarmed_controller_never_enforces(self):
+        ctl = SloController(enforce=False)
+        ctl.force_state("query_p99", EXHAUSTED)
+        ctl.force_state("freshness", EXHAUSTED)
+        assert ctl.query_route() == "live"
+        assert ctl.admit(10**9, 10**6) is None
+
+    def test_query_route_ladder(self):
+        ctl = SloController(enforce=True)
+        assert ctl.query_route() == "live"
+        ctl.force_state("query_p99", BURNING)
+        assert ctl.query_route() == "degraded"
+        ctl.force_state("query_p99", EXHAUSTED)
+        assert ctl.query_route() == "shed"
+        ctl.force_state("query_p99", None)
+        assert ctl.query_route() == "live"
+
+    def test_admission_ladder(self):
+        ctl = SloController(enforce=True, queue_cap=8)
+        assert ctl.admit(100, 100) is None
+        ctl.force_state("freshness", BURNING)
+        assert ctl.admit(4, 2) is None          # under the cap
+        assert ctl.admit(7, 2) is not None      # would cross the cap
+        ctl.force_state("freshness", EXHAUSTED)
+        assert ctl.admit(0, 1) is not None      # intake suspended
+        ctl.force_state("freshness", None)
+        assert ctl.admit(10**6, 1) is None
+
+    def test_force_state_rejects_unknown(self):
+        ctl = SloController(enforce=True)
+        with pytest.raises(ValueError):
+            ctl.force_state("query_p99", "melted")
+
+
+# the public metric surface, pinned verbatim (acceptance criterion)
+SLO_GAUGE_NAMES = (
+    "cyclonus_tpu_slo_burn_rate",
+    "cyclonus_tpu_slo_budget_remaining",
+    "cyclonus_tpu_slo_enforcement_state",
+    "cyclonus_tpu_slo_breaches_total",
+    "cyclonus_tpu_slo_shed_queries_total",
+    "cyclonus_tpu_slo_admission_rejects_total",
+)
+
+
+class TestExportedSurface:
+    def test_slo_gauge_names_pinned(self):
+        ctl = SloController(enforce=False)
+        ctl.tick(latency_snapshot=synth_hist(1, 0), now=1.0)
+        text = ti.REGISTRY.render_prometheus()
+        for name in SLO_GAUGE_NAMES:
+            assert f"# TYPE {name} " in text, name
+        assert (
+            'cyclonus_tpu_slo_burn_rate{objective="query_p99",'
+            'window="fast"}' in text
+        )
+        assert (
+            'cyclonus_tpu_slo_enforcement_state{objective="ttfv"}' in text
+        )
+
+    def test_snapshot_shape_pinned(self):
+        """The /slo JSON contract: exact key sets, stable across
+        refactors (fleet dashboards key on these)."""
+        ctl = SloController(enforce=True)
+        ctl.tick(latency_snapshot=synth_hist(5, 1), now=1.0)
+        snap = ctl.snapshot()
+        assert set(snap) == {
+            "enforce", "queue_cap", "ticks", "shed_queries",
+            "admission_rejects", "objectives",
+        }
+        assert set(snap["objectives"]) == {"query_p99", "freshness", "ttfv"}
+        for obj in snap["objectives"].values():
+            assert set(obj) == {
+                "signal", "target_s", "budget", "windows", "burn",
+                "budget_remaining", "state", "enforces", "breaches",
+            }
+            assert set(obj["windows"]) == {"fast_s", "slow_s"}
+            assert set(obj["burn"]) == {"fast", "slow"}
+        assert json.loads(json.dumps(snap)) == snap  # JSON-safe
+
+    def test_declared_objectives_registry(self):
+        objs = {o.name: o for o in declared_objectives()}
+        assert list(objs) == ["query_p99", "freshness", "ttfv"]
+        assert objs["query_p99"].kind == HISTOGRAM
+        assert (
+            objs["query_p99"].signal
+            == "cyclonus_tpu_serve_query_latency_seconds"
+        )
+        assert objs["freshness"].kind == GAUGE
+        assert (
+            objs["freshness"].signal == "cyclonus_tpu_serve_staleness_seconds"
+        )
+        assert objs["ttfv"].kind == ONCE
+
+    def test_objectives_are_env_tunable(self, monkeypatch):
+        monkeypatch.setenv("CYCLONUS_SLO_QUERY_P99_S", "0.5")
+        monkeypatch.setenv("CYCLONUS_SLO_BUDGET", "0.2")
+        objs = {o.name: o for o in declared_objectives()}
+        assert objs["query_p99"].target_s == 0.5
+        assert objs["freshness"].budget == 0.2
+        monkeypatch.setenv("CYCLONUS_SLO_BUDGET", "not-a-number")
+        objs = {o.name: o for o in declared_objectives()}
+        assert objs["query_p99"].budget == 0.01  # degrade, never raise
+
+
+def _get_json(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestSloHttpRoute:
+    def test_slo_route_payload_and_unregistered_503(self):
+        from cyclonus_tpu.telemetry.server import (
+            register_slo,
+            start_metrics_server,
+            stop_metrics_server,
+        )
+
+        register_slo(None)
+        srv = start_metrics_server(0)
+        try:
+            status, body = _get_json(srv.url + "/slo")
+            assert status == 503 and "no slo provider" in body["error"]
+            ctl = SloController(enforce=True)
+            ctl.tick(latency_snapshot=synth_hist(9, 1), now=1.0)
+            register_slo(ctl.snapshot)
+            status, body = _get_json(srv.url + "/slo")
+            assert status == 200
+            assert body["enforce"] is True
+            assert set(body["objectives"]) == {
+                "query_p99", "freshness", "ttfv",
+            }
+            q = body["objectives"]["query_p99"]
+            assert {"burn", "budget_remaining", "state"} <= set(q)
+        finally:
+            register_slo(None)
+            stop_metrics_server()
+
+    def test_broken_provider_answers_500(self):
+        from cyclonus_tpu.telemetry.server import (
+            register_slo,
+            start_metrics_server,
+            stop_metrics_server,
+        )
+
+        def boom():
+            raise RuntimeError("controller exploded")
+
+        register_slo(boom)
+        srv = start_metrics_server(0)
+        try:
+            status, body = _get_json(srv.url + "/slo")
+            assert status == 500 and "controller exploded" in body["error"]
+        finally:
+            register_slo(None)
+            stop_metrics_server()
+
+
+def mk_cluster(n_pods=10):
+    namespaces = {"x": {"ns": "x"}, "y": {"ns": "y"}}
+    pods = []
+    for i in range(n_pods):
+        ns = "x" if i % 2 == 0 else "y"
+        labels = {"app": f"a{i % 3}", "tier": f"t{i % 2}"}
+        pods.append((ns, f"p{i}", labels, f"10.0.0.{i + 1}"))
+    return pods, namespaces
+
+
+def mk_service(**kw):
+    from cyclonus_tpu.serve import VerdictService
+
+    pods, namespaces = mk_cluster()
+    return VerdictService(pods, namespaces, [], **kw)
+
+
+def mk_queries(n=6):
+    import random
+
+    from cyclonus_tpu.worker.model import FlowQuery
+
+    pods, _ = mk_cluster()
+    keys = [f"{p[0]}/{p[1]}" for p in pods]
+    rng = random.Random(3)
+    return [
+        FlowQuery(src=rng.choice(keys), dst=rng.choice(keys), port=80,
+                  protocol="TCP", port_name="serve-80-tcp")
+        for _ in range(n)
+    ]
+
+
+def bits(v):
+    return (v.ingress, v.egress, v.combined, v.error)
+
+
+class TestServiceEnforcement:
+    """Enforcement wired into VerdictService, against forced states
+    (the accounting-driven arc is tools/slo_drill.py's job)."""
+
+    def test_shed_never_changes_a_verdict(self):
+        """The differential gate extended to the shed path: answers
+        before shed, after recovery, and on the degraded route are all
+        bit-identical to an unenforced twin; shed answers are typed
+        refusals, never verdicts."""
+        svc = mk_service(slo=SloController(enforce=True))
+        twin = mk_service(slo=SloController(enforce=False))
+        queries = mk_queries()
+        baseline = [bits(v) for v in twin.query(queries)]
+        assert [bits(v) for v in svc.query(queries)] == baseline
+        svc.slo.force_state("query_p99", BURNING)  # degraded route
+        degraded = svc.query(queries)
+        assert [bits(v) for v in degraded] == baseline
+        svc.slo.force_state("query_p99", EXHAUSTED)
+        shed0 = ti.SLO_SHED.value()
+        out = svc.query(queries)
+        assert all(v.shed for v in out)
+        assert all(v.error for v in out)  # a refusal, not all-False bits
+        assert ti.SLO_SHED.value() == shed0 + len(queries)
+        svc.slo.force_state("query_p99", None)
+        assert [bits(v) for v in svc.query(queries)] == baseline
+
+    def test_shed_verdict_wire_roundtrip(self):
+        from cyclonus_tpu.worker.model import Verdict
+
+        svc = mk_service(slo=SloController(enforce=True))
+        svc.slo.force_state("query_p99", EXHAUSTED)
+        v = svc.query(mk_queries(1))[0]
+        d = v.to_dict()
+        assert d["Shed"] is True and d["Error"]
+        rt = Verdict.from_dict(d)
+        assert rt.shed is True
+        # omitted-when-unset: a live verdict emits no Shed key at all
+        svc.slo.force_state("query_p99", None)
+        assert "Shed" not in svc.query(mk_queries(1))[0].to_dict()
+
+    def test_admission_control_on_submit(self):
+        from cyclonus_tpu.serve.service import AdmissionRejected
+        from cyclonus_tpu.worker.model import Delta
+
+        svc = mk_service(slo=SloController(enforce=True, queue_cap=2))
+        delta = Delta(kind="ns_labels", namespace="x", labels={"k": "v"})
+        svc.slo.force_state("freshness", EXHAUSTED)
+        rejects0 = ti.SLO_ADMISSION_REJECTS.value()
+        with pytest.raises(AdmissionRejected):
+            svc.submit([delta])
+        assert ti.SLO_ADMISSION_REJECTS.value() == rejects0 + 1
+        with svc._lock:
+            assert len(svc._queue) == 0  # nothing was enqueued
+        svc.slo.force_state("freshness", BURNING)
+        assert svc.submit([delta]) == 1  # under the cap
+        with pytest.raises(AdmissionRejected):
+            svc.submit([delta, delta])  # 1 pending + 2 > cap 2
+        svc.slo.force_state("freshness", None)
+        assert svc.submit([delta, delta]) == 3
+
+    def test_wire_loop_reports_admission_backpressure(self):
+        from cyclonus_tpu.serve.loop import handle_line
+        from cyclonus_tpu.worker.model import Batch, Delta
+
+        svc = mk_service(slo=SloController(enforce=True))
+        svc.slo.force_state("freshness", EXHAUSTED)
+        line = Batch(
+            namespace="", pod="", container="",
+            deltas=[Delta(kind="ns_labels", namespace="x",
+                          labels={"k": "v"})],
+            queries=mk_queries(2),
+        ).to_json()
+        reply = handle_line(svc, line)
+        assert reply["Applied"] == 0
+        assert "freshness" in reply["Admission"]
+        # the line's queries still answered (no delta was applied)
+        assert len(reply["Verdicts"]) == 2
+
+    def test_http_query_maps_shed_to_429(self):
+        import cyclonus_tpu.telemetry.server as tserver
+        from cyclonus_tpu.serve.service import register_http
+
+        svc = mk_service(slo=SloController(enforce=True))
+        register_http(svc)
+        try:
+            fn = tserver._route_for("/query")
+            q = mk_queries(1)[0]
+            payload, code = fn({
+                "src": [q.src], "dst": [q.dst], "port": [str(q.port)],
+                "protocol": [q.protocol], "portName": [q.port_name],
+            })
+            assert code == 200 and "Shed" not in payload
+            svc.slo.force_state("query_p99", EXHAUSTED)
+            payload, code = fn({
+                "src": [q.src], "dst": [q.dst], "port": [str(q.port)],
+                "protocol": [q.protocol], "portName": [q.port_name],
+            })
+            assert code == 429
+            assert payload["Shed"] is True and payload["Error"]
+        finally:
+            tserver.unregister_route("/query")
+            tserver.unregister_route("/state")
+            tserver.register_slo(None)
+
+    def test_state_carries_the_slo_block(self):
+        svc = mk_service(slo=SloController(enforce=True))
+        block = svc.state()["slo"]
+        assert block["enforce"] is True
+        assert set(block["objectives"]) == {"query_p99", "freshness", "ttfv"}
+        for o in block["objectives"].values():
+            assert set(o) == {"state", "budget_remaining"}
+
+    def test_gauge_refresh_contention_is_counted(self):
+        """Satellite: the silent-skip path in _refresh_gauges must
+        count itself.  Hold the service lock past the 0.2s try-lock
+        from another thread and scrape through the collector."""
+        svc = mk_service()
+        skipped0 = ti.SERVE_GAUGE_REFRESH_SKIPPED.value()
+        ticks0 = svc.slo.snapshot()["ticks"]
+        entered = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            with svc._lock:
+                entered.set()
+                release.wait(timeout=30)
+
+        t = threading.Thread(target=hold, daemon=True)
+        t.start()
+        assert entered.wait(timeout=10)
+        try:
+            svc._refresh_gauges()
+        finally:
+            release.set()
+            t.join(timeout=10)
+        assert ti.SERVE_GAUGE_REFRESH_SKIPPED.value() == skipped0 + 1
+        # the contended skip still advanced SLO latency accounting
+        assert svc.slo.snapshot()["ticks"] == ticks0 + 1
+        svc._refresh_gauges()  # uncontended: no further skips
+        assert ti.SERVE_GAUGE_REFRESH_SKIPPED.value() == skipped0 + 1
+
+
+class TestHistogramQuantile:
+    """The graduated estimator (telemetry.metrics): linear
+    interpolation inside the winning bucket, serve re-export intact."""
+
+    def test_interpolates_inside_the_bucket(self):
+        from cyclonus_tpu.telemetry.metrics import histogram_quantile
+
+        # 100 events uniformly in (0.05, 0.2]: the median estimate sits
+        # mid-bucket, NOT at the 0.2 upper bound the old estimator gave
+        snap = synth_hist(0, 100)
+        assert histogram_quantile(snap, 0.5) == pytest.approx(0.125)
+        assert histogram_quantile(snap, 1.0) == pytest.approx(0.2)
+
+    def test_first_bucket_interpolates_from_zero(self):
+        from cyclonus_tpu.telemetry.metrics import histogram_quantile
+
+        snap = synth_hist(100, 0)
+        assert histogram_quantile(snap, 0.5) == pytest.approx(0.025)
+
+    def test_cross_bucket_rank(self):
+        from cyclonus_tpu.telemetry.metrics import histogram_quantile
+
+        snap = synth_hist(50, 50)
+        # p75: rank 75 lands 25 events into the second bucket of 50
+        assert histogram_quantile(snap, 0.75) == pytest.approx(
+            0.05 + (0.2 - 0.05) * 0.5
+        )
+
+    def test_empty_and_none(self):
+        from cyclonus_tpu.telemetry.metrics import histogram_quantile
+
+        assert histogram_quantile({"buckets": [], "samples": []}, 0.5) is None
+        assert histogram_quantile(synth_hist(0, 0), 0.99) is None
+
+    def test_serve_reexport_is_the_same_function(self):
+        from cyclonus_tpu.serve import service as sservice
+        from cyclonus_tpu.telemetry import metrics as tmetrics
+
+        assert sservice.histogram_quantile is tmetrics.histogram_quantile

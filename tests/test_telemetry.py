@@ -232,6 +232,37 @@ class TestFlightRecorder:
         assert data["entries"][0]["path"] == "counts.pallas"
         assert data["entries"][0]["cells"] == 8192
 
+    def test_crash_hook_skips_benign_terminations(self, tmp_path):
+        """sys.exit / Ctrl-C / a closed stdout pipe are not crashes: the
+        _NO_DUMP exemptions must leave no dump file behind even with a
+        populated ring (the hook is installed by the record())."""
+        for snippet, rc in (
+            ("raise SystemExit(3)", 3),
+            ("raise KeyboardInterrupt()", None),  # interpreter picks rc
+            ("raise BrokenPipeError('stdout gone')", 1),
+        ):
+            dump_path = str(tmp_path / "no-dump.json")
+            code = (
+                "from cyclonus_tpu.telemetry import recorder\n"
+                "recorder.record(path='x', outcome='ok')\n"
+                f"{snippet}\n"
+            )
+            env = dict(os.environ, CYCLONUS_FLIGHT_RECORDER_PATH=dump_path)
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=120,
+                cwd=REPO,
+                env=env,
+            )
+            assert proc.returncode != 0, snippet
+            if rc is not None:
+                assert proc.returncode == rc, snippet
+            assert not os.path.exists(dump_path), (
+                f"{snippet} must not leave a crash dump"
+            )
+
     def test_telemetry_cli_renders_flight_file(self, tmp_path, capsys):
         from cyclonus_tpu.cli.root import main
 

@@ -396,6 +396,34 @@ class TestProfileEndpoint:
         finally:
             stop_metrics_server()
 
+    def test_profile_rejects_concurrent_capture(self):
+        """The jax profiler is a process singleton: while one capture
+        holds _PROFILE_LOCK, a second /profile must answer 409 (typed
+        refusal) instead of queueing behind or corrupting the capture."""
+        import cyclonus_tpu.telemetry.server as tserver
+        from cyclonus_tpu.telemetry.server import (
+            start_metrics_server,
+            stop_metrics_server,
+        )
+
+        srv = start_metrics_server(0)
+        assert tserver._PROFILE_LOCK.acquire(blocking=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    srv.url + "/profile?seconds=0.2", timeout=30
+                )
+            assert exc.value.code == 409
+            body = json.loads(exc.value.read())
+            assert "already running" in body["error"]
+        finally:
+            tserver._PROFILE_LOCK.release()
+            stop_metrics_server()
+        # the refusal released nothing it didn't take: a fresh capture
+        # still acquires cleanly
+        assert tserver._PROFILE_LOCK.acquire(blocking=False)
+        tserver._PROFILE_LOCK.release()
+
 
 class TestMetricsPortBusy:
     def test_server_raises_one_line_error(self):

@@ -176,6 +176,7 @@ class TestWireCompatibility:
                 "Epoch": (int, True),
                 "Error": (str, True),
                 "LatencyMs": (float, True),
+                "Shed": (bool, True),
             },
         }
         for cls, want in golden.items():

@@ -30,7 +30,7 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
          "cyclonus_tpu/worker", "cyclonus_tpu/analysis",
          "cyclonus_tpu/probe", "cyclonus_tpu/perfobs",
          "cyclonus_tpu/serve", "cyclonus_tpu/tiers", "cyclonus_tpu/chaos",
-         "cyclonus_tpu/linter", "cyclonus_tpu/recipes"],
+         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo"],
         ["cyclonus_tpu/"],
     ),
     ("locklint", ["cyclonus_tpu"], ["cyclonus_tpu/"]),
@@ -39,11 +39,11 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
         ["cyclonus_tpu/engine", "cyclonus_tpu/analysis",
          "cyclonus_tpu/worker/model.py", "cyclonus_tpu/perfobs",
          "cyclonus_tpu/serve", "cyclonus_tpu/tiers", "cyclonus_tpu/chaos",
-         "cyclonus_tpu/linter", "cyclonus_tpu/recipes"],
+         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo"],
         ["cyclonus_tpu/engine", "cyclonus_tpu/analysis",
          "cyclonus_tpu/worker/model.py", "cyclonus_tpu/perfobs",
          "cyclonus_tpu/serve", "cyclonus_tpu/tiers", "cyclonus_tpu/chaos",
-         "cyclonus_tpu/linter", "cyclonus_tpu/recipes"],
+         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo"],
     ),
     (
         "cachelint",
@@ -55,9 +55,10 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
     (
         "planlint",
         ["--manifest", "artifacts/plan_manifest.json",
-         "cyclonus_tpu/engine", "cyclonus_tpu/serve", "cyclonus_tpu/tiers"],
+         "cyclonus_tpu/engine", "cyclonus_tpu/serve", "cyclonus_tpu/tiers",
+         "cyclonus_tpu/slo"],
         ["cyclonus_tpu/engine", "cyclonus_tpu/serve", "cyclonus_tpu/tiers",
-         "Makefile", "tests/"],
+         "cyclonus_tpu/slo", "Makefile", "tests/"],
     ),
 )
 
