@@ -38,23 +38,23 @@ lint: shapelint cachelint planlint
 	  cyclonus_tpu/worker cyclonus_tpu/analysis cyclonus_tpu/probe \
 	  cyclonus_tpu/perfobs cyclonus_tpu/serve cyclonus_tpu/tiers \
 	  cyclonus_tpu/chaos cyclonus_tpu/linter cyclonus_tpu/recipes \
-	  cyclonus_tpu/slo
+	  cyclonus_tpu/slo cyclonus_tpu/audit
 	python tools/locklint.py cyclonus_tpu
 
 shapelint:
 	python tools/shapelint.py cyclonus_tpu/engine cyclonus_tpu/analysis \
 	  cyclonus_tpu/worker/model.py cyclonus_tpu/perfobs cyclonus_tpu/serve \
 	  cyclonus_tpu/tiers cyclonus_tpu/chaos cyclonus_tpu/linter \
-	  cyclonus_tpu/recipes cyclonus_tpu/slo
+	  cyclonus_tpu/recipes cyclonus_tpu/slo cyclonus_tpu/audit
 
 cachelint:
 	python tools/cachelint.py cyclonus_tpu/engine cyclonus_tpu/serve \
-	  cyclonus_tpu/perfobs cyclonus_tpu/chaos
+	  cyclonus_tpu/perfobs cyclonus_tpu/chaos cyclonus_tpu/audit
 
 planlint:
 	python tools/planlint.py --manifest artifacts/plan_manifest.json \
 	  cyclonus_tpu/engine cyclonus_tpu/serve cyclonus_tpu/tiers \
-	  cyclonus_tpu/slo
+	  cyclonus_tpu/slo cyclonus_tpu/audit
 
 # git-diff-scoped lint: run only the legs whose scanned paths contain a
 # file changed vs the merge base (falls back to HEAD for a clean tree).
@@ -154,12 +154,24 @@ slo:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q
 	JAX_PLATFORMS=cpu python tools/slo_drill.py
 
+# the audit gate (docs/DESIGN.md "Audit plane"): the unit legs —
+# seeded-sampler determinism, epoch-digest bit-stability across engine
+# routes and across a subprocess restart, divergence capture with
+# bundle pins, queue-overflow drop accounting, the disabled-path
+# overhead differential — then the drill (tools/audit_drill.py): a REAL
+# serve with the shadow-oracle sampler armed at rate 1.0, /audit and
+# /metrics agreeing, replica-vs-replica digest equality at the same
+# epoch, and an armed verdict_corrupt detected within the check budget.
+audit:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_audit.py -q
+	JAX_PLATFORMS=cpu python tools/audit_drill.py
+
 # the one-command CI gate (mirrors reference go.yml build/fmt/vet/test):
 # syntax-compile everything, lint the hot paths, gate the perf history,
 # smoke the verdict service and the 8-device overlapped mesh path, run
 # the seeded tier fuzz gate (mesh leg included), run the chaos suite,
 # then run the suite on a CPU 8-device mesh
-check: vet lint perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke slo fuzz chaos
+check: vet lint perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke slo audit fuzz chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
 # opt-in: the full 216-case conformance suite with a journal artifact
@@ -208,4 +220,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos slo fmt vet lint lint-changed shapelint cachelint planlint keyharness planharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos slo audit fmt vet lint lint-changed shapelint cachelint planlint keyharness planharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker

@@ -944,8 +944,18 @@ def serve_churn_case(cases, headline_pods: int, headline_policies: int) -> dict:
     q_per_step = int(os.environ.get("BENCH_SERVE_QUERIES", "8"))
     rng = _random.Random(123)
     pods, namespaces, pol_objs = build_synthetic(n_pods, n_policies, rng)
+    # audit plane rides the churn leg: a seeded shadow-oracle sampler
+    # re-checks a fraction of the answered queries against the scalar
+    # oracle and digests every committed epoch — perfobs reads
+    # detail.audit (checked/diverged/digest_s) on every line, and any
+    # nonzero divergence is a warn-note in the sentinel
+    from cyclonus_tpu.audit import AuditController
+
+    aud = AuditController(
+        rate=float(os.environ.get("BENCH_AUDIT_RATE", "0.25")), seed=42
+    )
     t0 = time.perf_counter()
-    svc = VerdictService(pods, namespaces, pol_objs)
+    svc = VerdictService(pods, namespaces, pol_objs, audit=aud)
     build_s = time.perf_counter() - t0
     full_rebuild_s = svc.state()["last_full_rebuild_s"]
     # warm the device state + the query program before timing churn
@@ -1073,6 +1083,23 @@ def serve_churn_case(cases, headline_pods: int, headline_policies: int) -> dict:
         "slo_budget_remaining": st["slo"]["objectives"]["query_p99"][
             "budget_remaining"
         ],
+        "audit": _audit_leg_detail(aud),
+    }
+
+
+def _audit_leg_detail(aud) -> dict:
+    """Drain the churn leg's audit controller and reduce its snapshot
+    to the detail.audit block perfobs ingests."""
+    aud.flush(timeout=30.0)
+    snap = aud.snapshot()
+    aud.close()
+    latest = snap.get("latest") or {}
+    return {
+        "checked": int(snap["checked"]),
+        "diverged": int(snap["diverged"]),
+        "digest_s": latest.get("seconds"),
+        "digest": latest.get("digest"),
+        "dropped": dict(snap["dropped"]),
     }
 
 
@@ -1098,6 +1125,17 @@ def _serve_churn_leg(cases, n_pods: int, n_policies: int):
         "status": status,
         "error": None if status == "timeout" else repr(value),
     }
+
+
+def _audit_detail(serve_detail):
+    """The top-level detail.audit block (perfobs reads it on every
+    line): lifted out of the serve leg's report — None when the leg was
+    skipped, timed out, or predates the audit plane."""
+    if isinstance(serve_detail, dict):
+        a = serve_detail.get("audit")
+        if isinstance(a, dict):
+            return a
+    return None
 
 
 def _chaos_leg():
@@ -2174,6 +2212,10 @@ def _bench(done):
                         # differential-parity assertions enforced
                         # (perfobs reads detail.serve on every line)
                         "serve": serve_detail,
+                        # the audit plane's churn-leg accounting
+                        # (perfobs reads detail.audit on every line;
+                        # nonzero diverged is a sentinel warn-note)
+                        "audit": _audit_detail(serve_detail),
                         "chaos": chaos_detail,
                         # the precedence-tier leg (BENCH_TIERS=0 skips,
                         # still recording {active: False}): ANP/BANP
@@ -2292,6 +2334,7 @@ def _bench(done):
                     "class_compression": engine.class_compression_stats(),
                     "mesh": mesh_detail,
                     "serve": serve_detail,
+                    "audit": _audit_detail(serve_detail),
                     "chaos": chaos_detail,
                     "tiers": tiers_detail,
                     "telemetry": tel_snapshot,

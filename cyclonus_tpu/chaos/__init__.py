@@ -15,6 +15,11 @@ Two halves:
         worker_wire        worker/client.py batch issue (raise)
         worker_wire_stall  worker/client.py batch issue (sleep ARG
                            seconds; trips the per-batch timeout)
+        verdict_corrupt    audit/sampler.py offer(): flips a SAMPLED
+                           verdict's allow bits at the audit intake —
+                           the end-to-end proof the shadow-oracle
+                           sampler detects a corruption within a
+                           bounded number of checks
 
   - the harness (chaos/harness.py): seeded, bounded scenarios — kill
     and restart `cyclonus-tpu serve` mid-churn with a bounded

@@ -534,8 +534,132 @@ def scenario_slo_ttfv(
     }
 
 
+def scenario_audit_divergence(
+    seed: int = 0,
+    workdir: Optional[str] = None,
+    n_pods: int = 12,
+    check_budget: int = 32,
+) -> Dict:
+    """The audit plane's end-to-end detection contract, on a REAL serve
+    under churn: arm `verdict_corrupt` (one flipped sampled verdict)
+    and the shadow-oracle sampler must detect it within the check
+    budget, leaving an `audit-divergence` flight-recorder bundle on
+    disk; then the SAME churn with the point disarmed must finish with
+    no divergence dump at all."""
+    import tempfile
+
+    from ..worker.model import Batch, Delta, FlowQuery
+
+    workdir = workdir or tempfile.mkdtemp(prefix="cyclonus-chaos-audit-")
+    n_ns = 2
+    rng = random.Random(seed)
+
+    def churn(srv, keys, dump_file, budget) -> Optional[int]:
+        """Deltas + query batches until the divergence dump appears (the
+        audit worker is async — poll between batches); returns the
+        number of audited-eligible queries sent before detection, or
+        None when the budget ran out without a dump."""
+        sent = 0
+        for step in range(budget):
+            key = keys[rng.randrange(len(keys))]
+            ns, name = key.split("/", 1)
+            line = Batch(
+                namespace="", pod="", container="",
+                deltas=[Delta(
+                    kind="pod_labels", namespace=ns, name=name,
+                    labels={"pod": f"p{step}", "app": f"a{step % 7}"},
+                )],
+                queries=[FlowQuery(
+                    src=keys[rng.randrange(len(keys))],
+                    dst=keys[rng.randrange(len(keys))],
+                    port=80, protocol="TCP", port_name="serve-80-tcp",
+                )],
+            ).to_json()
+            reply = srv.round_trip(line)
+            if reply.get("Error"):
+                raise AssertionError(f"churn line rejected: {reply}")
+            sent += 1
+            deadline = time.perf_counter() + 0.5
+            while time.perf_counter() < deadline:
+                if os.path.exists(dump_file):
+                    return sent
+                time.sleep(0.05)
+        return None
+
+    from ..cli.serve_cmd import synthetic_cluster
+
+    pods, _namespaces = synthetic_cluster(n_pods, n_ns, seed)
+    keys = [f"{p[0]}/{p[1]}" for p in pods]
+
+    # phase 1: armed — every query sampled (rate 1.0), one corruption
+    armed_dump = os.path.join(workdir, "audit-armed.json")
+    srv = _Serve(n_pods, n_ns, seed, workdir, "audit-armed", env={
+        "CYCLONUS_AUDIT": "1",
+        "CYCLONUS_AUDIT_RATE": "1.0",
+        "CYCLONUS_CHAOS": "verdict_corrupt:1",
+        "CYCLONUS_FLIGHT_RECORDER_PATH": armed_dump,
+    })
+    try:
+        detected_after = churn(srv, keys, armed_dump, check_budget)
+    finally:
+        srv.kill()
+    if detected_after is None:
+        raise AssertionError(
+            f"armed verdict_corrupt went undetected through "
+            f"{check_budget} checks (no audit-divergence dump)"
+        )
+    with open(armed_dump) as f:
+        dumped = json.load(f)
+    if dumped.get("reason") != "audit-divergence":
+        raise AssertionError(
+            f"divergence dump reason {dumped.get('reason')!r} "
+            "(want 'audit-divergence')"
+        )
+    div_entries = [
+        e for e in dumped.get("entries") or []
+        if e.get("path") == "audit.divergence"
+    ]
+    if not div_entries:
+        raise AssertionError("divergence dump carries no repro bundle")
+    bundle = div_entries[-1]
+    for field in ("query", "served", "oracle", "route", "epoch", "config"):
+        if field not in bundle:
+            raise AssertionError(f"repro bundle missing {field!r}")
+
+    # phase 2: disarmed — the same churn must audit clean (no dump)
+    clean_dump = os.path.join(workdir, "audit-clean.json")
+    srv2 = _Serve(n_pods, n_ns, seed, workdir, "audit-clean", env={
+        "CYCLONUS_AUDIT": "1",
+        "CYCLONUS_AUDIT_RATE": "1.0",
+        "CYCLONUS_CHAOS": "",
+        "CYCLONUS_FLIGHT_RECORDER_PATH": clean_dump,
+    })
+    try:
+        clean = churn(srv2, keys, clean_dump, min(check_budget, 8))
+        rc = srv2.close()
+    except Exception:
+        srv2.kill()
+        raise
+    if rc != 0:
+        raise AssertionError(f"disarmed serve exited rc={rc}")
+    if clean is not None or os.path.exists(clean_dump):
+        raise AssertionError(
+            "disarmed run produced an audit-divergence dump — the "
+            "sampler diverged with no injected fault"
+        )
+    return {
+        "ok": True,
+        "detected_after_checks": detected_after,
+        "check_budget": check_budget,
+        "bundle_route": bundle.get("route"),
+        "bundle_epoch": bundle.get("epoch"),
+        "dump": armed_dump,
+    }
+
+
 SCENARIOS = {
     "serve_kill_restart": scenario_serve_kill_restart,
+    "audit_divergence": scenario_audit_divergence,
     "slo_ttfv": scenario_slo_ttfv,
     "poisoned_caches": scenario_poisoned_caches,
     "backend_init_flake": scenario_backend_init_flake,

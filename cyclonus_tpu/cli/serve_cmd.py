@@ -177,8 +177,8 @@ def run_serve(args) -> int:
         print(
             f"serve: metrics on {srv.url}/metrics, state on "
             f"{srv.url}/state, queries on {srv.url}/query, readiness "
-            f"on {srv.url}/readyz, slo on {srv.url}/slo "
-            f"(port {srv.port})",
+            f"on {srv.url}/readyz, slo on {srv.url}/slo, audit on "
+            f"{srv.url}/audit (port {srv.port})",
             file=sys.stderr,
         )
     if prewarm_on:
@@ -198,10 +198,16 @@ def run_serve(args) -> int:
             f", {st['tiers']['anp_count']} ANPs"
             f"{' + BANP' if st['tiers']['banp'] else ''}"
         )
+    audit_note = ""
+    if service.audit is not None:
+        audit_note = (
+            f", audit armed (rate {service.audit.rate:g}, "
+            f"seed {service.audit.seed})"
+        )
     print(
         f"serve: engine ready — {st['pods']} pods, {st['policies']} "
-        f"policies{tier_note} (epoch {st['epoch']}); reading batches "
-        f"from stdin",
+        f"policies{tier_note} (epoch {st['epoch']}){audit_note}; "
+        f"reading batches from stdin",
         file=sys.stderr,
     )
     run_stdio(service, sys.stdin, sys.stdout, max_lines=args.max_lines)

@@ -295,6 +295,15 @@ PATHS: Tuple[PathSpec, ...] = (
         gate="tests/test_slo.py",
         when={"shed": True},
     ),
+    # --- audit plane shadow-oracle check ------------------------------------
+    PathSpec(
+        "serve.audit.check", "serve_audit",
+        stages=("epilogue",),  # scalar oracle on the worker thread
+        flags=("CYCLONUS_AUDIT", "CYCLONUS_AUDIT_RATE"),
+        cache_key_family="",  # host-only: no compiled program
+        gate="tests/test_audit.py",
+        when={},
+    ),
 )
 
 REGISTRY: Dict[str, PathSpec] = {p.name: p for p in PATHS}

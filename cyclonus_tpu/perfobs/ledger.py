@@ -194,6 +194,14 @@ def _bench_run_from_parsed(
             run.serve_slo_budget_remaining = float(
                 serve["slo_budget_remaining"]
             )
+    audit = detail.get("audit")
+    if isinstance(audit, dict):
+        if isinstance(audit.get("checked"), int):
+            run.audit_checked = int(audit["checked"])
+        if isinstance(audit.get("diverged"), int):
+            run.audit_diverged = int(audit["diverged"])
+        if isinstance(audit.get("digest_s"), (int, float)):
+            run.audit_digest_s = float(audit["digest_s"])
     tiers = detail.get("tiers")
     if isinstance(tiers, dict):
         run.tiers_active = bool(tiers.get("active"))

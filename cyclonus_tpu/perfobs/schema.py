@@ -144,6 +144,14 @@ class PerfRun:
     # sentinel (new fields ride warn-only first); the bench leg itself
     # hard-bounds it via CYCLONUS_CHAOS_TTFV_S.
     chaos_ttfv_s: Optional[float] = None
+    # detail.audit — the verdict audit plane's per-run accounting
+    # (None: auditing disabled, leg skipped, or an older artifact).
+    # Warn-only in the sentinel like the other serve fields — EXCEPT
+    # that any nonzero audit_diverged gets its own note: a divergence
+    # is a correctness signal, not a trend.
+    audit_checked: Optional[int] = None
+    audit_diverged: Optional[int] = None
+    audit_digest_s: Optional[float] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -194,6 +202,9 @@ class PerfRun:
             "aot_adopted": self.aot_adopted,
             "aot_compiles": self.aot_compiles,
             "chaos_ttfv_s": self.chaos_ttfv_s,
+            "audit_checked": self.audit_checked,
+            "audit_diverged": self.audit_diverged,
+            "audit_digest_s": self.audit_digest_s,
             "error": self.error,
             "metric": self.metric,
         }

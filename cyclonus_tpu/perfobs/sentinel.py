@@ -431,6 +431,24 @@ def gate(
                 "the same churn workload"
             )
 
+    # --- audit plane: WARN, never fail ----------------------------------
+    # a nonzero divergence count is a CORRECTNESS signal, not a trend —
+    # but the bench leg's own assertions (and tests/test_audit.py) are
+    # the hard gate; here it rides warn-only like the serve fields so
+    # one flaky artifact can't block a perf round
+    if (
+        isinstance(candidate.audit_diverged, int)
+        and candidate.audit_diverged > 0
+    ):
+        notes.append(
+            "WARNING: audit plane observed "
+            f"{candidate.audit_diverged} shadow-oracle divergence(s) "
+            f"across {candidate.audit_checked or 0} checks — reported "
+            "only (warn, not fail); open the audit-divergence "
+            "flight-recorder bundle before trusting this round's "
+            "verdicts"
+        )
+
     # --- precedence-tier leg: WARN, never fail --------------------------
     # same discipline as serve: the leg's oracle spot-parity assertion
     # already fails the bench on correctness, and BENCH_TIERS_* knobs

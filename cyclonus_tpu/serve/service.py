@@ -101,6 +101,9 @@ def register_http(service: "VerdictService") -> None:
                                       (429 when the query was shed)
         /slo                          per-objective budget remaining,
                                       burn rates, enforcement state
+        /audit                        audit-plane snapshot: shadow-
+                                      oracle check counts, queue
+                                      accounting, epoch state digests
     """
     from ..telemetry import server as tserver
 
@@ -134,6 +137,7 @@ def register_http(service: "VerdictService") -> None:
     tserver.register_route("/state", state_route)
     tserver.register_route("/query", query_route)
     tserver.register_slo(service.slo_snapshot)
+    tserver.register_audit(service.audit_snapshot)
 
 
 @guards.checked
@@ -162,6 +166,7 @@ class VerdictService:
         tiers: Optional[TierSet] = None,
         defer_ready: bool = False,
         slo: Optional[SloController] = None,
+        audit: Optional["AuditController"] = None,
     ):
         self._lock = guards.lock()
         # SLO controller (cyclonus_tpu/slo): created at construction so
@@ -223,12 +228,51 @@ class VerdictService:
         # WeakMethod-registered — a garbage-collected service (tests
         # build many) drops out of the scrape path on its own.
         ti.REGISTRY.register_collector(self._refresh_gauges)
+        # audit plane (cyclonus_tpu/audit): disabled leaves _audit None
+        # and every query path at exactly one attribute check.  Lock
+        # order: service._lock -> audit._lock (note_epoch runs under
+        # this lock; offer after it is released; the audit worker never
+        # takes the service lock).
+        if audit is None and envflags.get_bool("CYCLONUS_AUDIT"):
+            from ..audit import AuditController
+
+            audit = AuditController()
+        self._audit = audit
+        if self._audit is not None:
+            with self._lock:
+                self._note_epoch_locked()
 
     # --- engine lifecycle -------------------------------------------------
 
     def _compiled_policy(self):
         return build_network_policies(
             self._simplify, list(self.netpols.values())
+        )
+
+    @guards.holds("self._lock")
+    def _note_epoch_locked(self) -> None:
+        """Hand the just-committed epoch's state to the audit plane:
+        fresh shallow dict copies are stable snapshots because every
+        apply REPLACES values wholesale (the rollback-snapshot
+        discipline above).  Digest + shadow checks run on the audit
+        worker thread, never here.
+
+        holds-lock: self._lock"""
+        self._audit.note_epoch(
+            self._epoch,
+            pods=dict(self.pods),
+            namespaces=dict(self.namespaces),
+            netpols=dict(self.netpols),
+            anps=dict(self.anps),
+            banp=self.banp,
+            policy=self._policy,
+            tiers=self._tier_set(),
+            config={
+                "simplify": self._simplify,
+                "class_compress": self._class_compress,
+                "anps": len(self.anps),
+                "banp": self.banp is not None,
+            },
         )
 
     def _tier_set(self) -> Optional[TierSet]:
@@ -560,6 +604,8 @@ class VerdictService:
             self._counts[mode] += 1
             ti.SERVE_APPLIES.inc(mode=mode)
             ti.SERVE_EPOCH.set(self._epoch)
+            if self._audit is not None:
+                self._note_epoch_locked()
             dt = time.perf_counter() - t0
             self._last_apply_s = dt
             ti.SERVE_APPLY_SECONDS.observe(dt, mode=mode)
@@ -778,6 +824,8 @@ class VerdictService:
             planspec.record("serve.query.degraded")
             out = self._query_degraded(queries)
             self._slo.note_first_verdict()
+            if self._audit is not None:
+                self._offer_audit(out, "serve.query.degraded")
             return out
         planspec.record("serve.query.live")
         t0 = time.perf_counter()
@@ -797,7 +845,39 @@ class VerdictService:
             ti.SERVE_QUERY_LATENCY.observe(per)
         ti.SERVE_QUERIES.inc(len(queries))
         self._slo.note_first_verdict()
+        if self._audit is not None:
+            self._offer_audit(out, "serve.query.live")
         return [v for v in out if v is not None]
+
+    def _offer_audit(
+        self, verdicts: Sequence[Optional[Verdict]], route: str
+    ) -> None:
+        """Feed answered (non-error, non-shed) verdicts to the audit
+        sampler.  Called with the service lock RELEASED — the sampler
+        takes only its own lock, keeping the acquisition graph acyclic.
+        The per-verdict cost is one seeded Bernoulli draw; the offer
+        entry is built only for the sampled minority, and everything
+        else happens on the audit worker."""
+        aud = self._audit
+        for v in verdicts:
+            if v is None or v.error or getattr(v, "shed", False):
+                continue
+            if not aud.sample():
+                continue
+            q = v.query
+            aud.offer(
+                {
+                    "src": q.src,
+                    "dst": q.dst,
+                    "port": q.port,
+                    "port_name": q.port_name,
+                    "protocol": q.protocol,
+                },
+                (v.ingress, v.egress, v.combined),
+                route,
+                v.epoch,
+                presampled=True,
+            )
 
     @guards.holds("self._lock")
     def _query_locked(
@@ -1007,6 +1087,11 @@ class VerdictService:
                         self._slo.snapshot()["objectives"].items()
                     },
                 },
+                "audit": (
+                    self._audit.snapshot()
+                    if self._audit is not None
+                    else {"enabled": False}
+                ),
             }
 
     @property
@@ -1017,6 +1102,19 @@ class VerdictService:
     def slo_snapshot(self) -> Dict:
         """The /slo payload (telemetry/server.py register_slo)."""
         return self._slo.snapshot()
+
+    @property
+    def audit(self):
+        """The service's AuditController, or None when auditing is off
+        (tests, drills, harnesses)."""
+        return self._audit
+
+    def audit_snapshot(self) -> Dict:
+        """The /audit payload (telemetry/server.py register_audit)."""
+        aud = self._audit
+        if aud is None:
+            return {"enabled": False}
+        return aud.snapshot()
 
     # --- the differential correctness gate --------------------------------
 

@@ -35,7 +35,14 @@ from ..telemetry import recorder
 from ..utils import guards
 from . import accounting
 from .accounting import BURNING, EXHAUSTED, OK, BurnAccountant, Hysteresis
-from .objectives import GAUGE, HISTOGRAM, ONCE, Objective, declared_objectives
+from .objectives import (
+    COUNTER,
+    GAUGE,
+    HISTOGRAM,
+    ONCE,
+    Objective,
+    declared_objectives,
+)
 
 
 def events_over_target(snapshot: Dict, target_s: float) -> Dict[str, float]:
@@ -174,6 +181,15 @@ class SloController:
                         1.0 if staleness_s > obj.target_s else 0.0
                     )
                     tr.acct.observe(t, total, bad)
+                elif obj.kind == COUNTER:
+                    # cumulative (total, bad) straight off the audit
+                    # counters — the same shape the histogram fold
+                    # produces, so the accountant diffs it identically
+                    tr.acct.observe(
+                        t,
+                        float(ti.AUDIT_CHECKED.value()),
+                        float(ti.AUDIT_DIVERGED.value()),
+                    )
                 # ONCE objectives advance only via observe_ttfv
                 if tr.advance(t):
                     breached.append(tr)

@@ -3,7 +3,7 @@ registry (the engine/planspec.py discipline — declarations are live
 code the controller consumes, not documentation) over signals the
 telemetry stack already emits.
 
-Three objectives ship, one per signal family:
+Four objectives ship, one per signal family:
 
   * ``query_p99`` — per-flow query latency, from the
     cyclonus_tpu_serve_query_latency_seconds histogram.  An event is
@@ -16,6 +16,12 @@ Three objectives ship, one per signal family:
     per process.  Bad means the first verdict took longer than the
     target — the restart contract the chaos harness kills replicas to
     check.
+  * ``verdict_integrity`` — shadow-oracle audit divergences, from the
+    cumulative cyclonus_tpu_audit_checked/diverged counters
+    (cyclonus_tpu/audit).  An event is one audited verdict; bad means
+    the served allow bits disagreed with the scalar oracle.  Breach-
+    dump posture like ttfv: a divergence is forensic evidence, never a
+    reason to block queries.
 
 Every numeric knob is env-tunable through utils/envflags.py (the
 ``CYCLONUS_SLO_QUERY_P99_S``-style slo flag family) so a drill can
@@ -35,6 +41,7 @@ from ..utils import envflags
 HISTOGRAM = "histogram"  # cumulative latency histogram snapshots
 GAUGE = "gauge"          # one threshold sample per accounting tick
 ONCE = "once"            # a single per-process observation
+COUNTER = "counter"      # cumulative (total, bad) counter pair
 
 
 @dataclass(frozen=True)
@@ -43,7 +50,7 @@ class Objective:
     good from bad events, the burn windows, and the error budget."""
 
     name: str
-    kind: str  # HISTOGRAM | GAUGE | ONCE
+    kind: str  # HISTOGRAM | GAUGE | ONCE | COUNTER
     signal: str  # the telemetry signal the objective is computed from
     target_s: float  # seconds: the good/bad event threshold
     budget: float  # error budget: tolerated bad-event fraction
@@ -104,6 +111,25 @@ def declared_objectives() -> Tuple[Objective, ...]:
                 "time-to-first-verdict after restart: exceeding the "
                 "target is an immediate breach (black-box dump); the "
                 "chaos harness kills a replica mid-churn to check it"
+            ),
+        ),
+        Objective(
+            name="verdict_integrity",
+            kind=COUNTER,
+            signal="cyclonus_tpu_audit_diverged_total",
+            # target_s is unused for a counter objective (good/bad is
+            # decided at the signal: a diverged check IS a bad event);
+            # declared 0.0 so the snapshot schema stays uniform.
+            target_s=0.0,
+            budget=budget,
+            fast_s=fast_s,
+            slow_s=slow_s,
+            enforces="breach-dump",
+            description=(
+                "shadow-oracle verdict integrity: any audited verdict "
+                "disagreeing with the scalar oracle burns budget and "
+                "exhaustion dumps the black box (audit-divergence "
+                "bundles carry the repro) — never query-blocking"
             ),
         ),
     )

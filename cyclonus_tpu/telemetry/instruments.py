@@ -358,6 +358,45 @@ SLO_ADMISSION_REJECTS = REGISTRY.counter(
     "admission control.",
 )
 
+# --- audit plane (cyclonus_tpu/audit) ------------------------------------
+
+AUDIT_CHECKED = REGISTRY.counter(
+    "cyclonus_tpu_audit_checked_total",
+    "Audit plane: sampled verdicts re-evaluated against the scalar "
+    "TieredPolicy oracle on the query-epoch snapshot.",
+)
+AUDIT_DIVERGED = REGISTRY.counter(
+    "cyclonus_tpu_audit_diverged_total",
+    "Audit plane: shadow-oracle checks whose allow bits disagreed with "
+    "the served verdict (each one dumps an audit-divergence bundle and "
+    "burns verdict_integrity).",
+)
+AUDIT_CHECK_LATENCY = REGISTRY.histogram(
+    "cyclonus_tpu_audit_check_latency_seconds",
+    "Audit plane: per-check shadow-oracle evaluation latency (host-"
+    "side, off the query path).",
+)
+AUDIT_QUEUE_DEPTH = REGISTRY.gauge(
+    "cyclonus_tpu_audit_queue_depth",
+    "Audit plane: sampled checks waiting in the bounded audit queue.",
+)
+AUDIT_DROPPED = REGISTRY.counter(
+    "cyclonus_tpu_audit_dropped_total",
+    "Audit plane: sampled checks dropped without evaluation (reason="
+    "overflow: queue at CYCLONUS_AUDIT_QUEUE; reason=epoch_evicted: "
+    "the query's epoch snapshot aged out of the ring).",
+    labelnames=("reason",),
+)
+AUDIT_DIGEST_SECONDS = REGISTRY.gauge(
+    "cyclonus_tpu_audit_digest_seconds",
+    "Audit plane: wall-clock seconds the latest epoch state digest "
+    "took to compute (background thread, never the query path).",
+)
+AUDIT_DIGEST_EPOCH = REGISTRY.gauge(
+    "cyclonus_tpu_audit_digest_epoch",
+    "Audit plane: newest epoch with a committed state digest.",
+)
+
 # --- real-probe latency --------------------------------------------------
 
 PROBE_LATENCY = REGISTRY.histogram(

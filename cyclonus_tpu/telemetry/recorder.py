@@ -11,9 +11,10 @@ to JSON:
   * on demand via `dump()` / the `cyclonus-tpu telemetry` CLI mode.
 
 The dump path is CYCLONUS_FLIGHT_RECORDER_PATH, defaulting to
-`cyclonus-flight-recorder-<pid>.json` in the working directory.  The
-crash hook never masks the crash: any dump failure is swallowed and the
-previous excepthook always runs.
+`artifacts/cyclonus-flight-recorder-<pid>.json` (the directory is
+created on dump, and the artifacts/ tree is gitignored so dumps never
+land in the working tree).  The crash hook never masks the crash: any
+dump failure is swallowed and the previous excepthook always runs.
 """
 
 from __future__ import annotations
@@ -68,7 +69,9 @@ def reset() -> None:
 def dump_path() -> str:
     return os.environ.get(
         "CYCLONUS_FLIGHT_RECORDER_PATH",
-        f"cyclonus-flight-recorder-{os.getpid()}.json",
+        os.path.join(
+            "artifacts", f"cyclonus-flight-recorder-{os.getpid()}.json"
+        ),
     )
 
 

@@ -24,6 +24,12 @@ it) and serves, on a daemon thread:
                        registered via register_slo() — 503 until a
                        provider registers (serve wires its controller
                        here)
+    /audit             verdict audit plane snapshot (cyclonus_tpu/
+                       audit): checked/diverged counts, queue depth,
+                       per-epoch state digests, and the last divergence
+                       summary as JSON, from the provider registered
+                       via register_audit() — 503 until one registers
+                       (serve wires its AuditController here)
 
 Extension routes registered via `register_route(path, fn)` serve JSON
 from the same thread — `cyclonus-tpu serve` adds /state (engine epoch,
@@ -140,6 +146,34 @@ def _slo_payload() -> tuple:
         return {"error": f"slo provider failed: {type(e).__name__}: {e}"}, 500
 
 
+# optional audit snapshot provider: fn() -> dict (the /audit payload —
+# shadow-oracle check counts, queue accounting, epoch state digests;
+# see cyclonus_tpu/audit).  Same contract as register_slo: 503 until a
+# provider registers, 500 when a registered provider breaks.
+_AUDIT: dict = {"fn": None}  # guarded-by: _ROUTES_LOCK
+
+
+def register_audit(fn) -> None:
+    """Register the process audit snapshot provider (replaces any
+    previous one; None unregisters)."""
+    with _ROUTES_LOCK:
+        _AUDIT["fn"] = fn
+
+
+def _audit_payload() -> tuple:
+    with _ROUTES_LOCK:
+        fn = _AUDIT["fn"]
+    if fn is None:
+        return {"error": "no audit provider registered"}, 503
+    try:
+        return dict(fn()), 200
+    except Exception as e:  # a broken provider must answer, not hang
+        return (
+            {"error": f"audit provider failed: {type(e).__name__}: {e}"},
+            500,
+        )
+
+
 class _Handler(BaseHTTPRequestHandler):
     def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
         self.send_response(code)
@@ -184,6 +218,9 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/slo":
             payload, code = _slo_payload()
+            self._send_json(payload, code)
+        elif path == "/audit":
+            payload, code = _audit_payload()
             self._send_json(payload, code)
         else:
             fn = _route_for(path)

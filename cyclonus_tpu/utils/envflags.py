@@ -38,7 +38,7 @@ class Flag:
     name: str
     kind: str  # "bool" | "int" | "float" | "enum" | "str" | "path"
     default: object
-    owner: str  # "engine" | "serve" | "worker" | "chaos" | "telemetry" | "probe" | "harness" | "cli" | "slo"
+    owner: str  # "engine" | "serve" | "worker" | "chaos" | "telemetry" | "probe" | "harness" | "cli" | "slo" | "audit"
     description: str
     choices: Tuple[str, ...] = field(default=())
 
@@ -188,6 +188,26 @@ _FLAGS = [
     Flag("CYCLONUS_SLO_HOLD_S", "float", 60.0, "slo",
          "Continuous below-exit-threshold time required to leave an "
          "enforcement state."),
+    # --- audit: shadow-oracle sampling + epoch digests ------------------
+    Flag("CYCLONUS_AUDIT", "bool", False, "audit",
+         "Arm the verdict audit plane (shadow-oracle sampler, epoch "
+         "digests, /audit route); off strips the query path to one "
+         "attribute check."),
+    Flag("CYCLONUS_AUDIT_RATE", "float", 0.05, "audit",
+         "Fraction of answered flow queries the shadow-oracle sampler "
+         "re-checks (seeded Bernoulli per verdict)."),
+    Flag("CYCLONUS_AUDIT_QUEUE", "int", 1024, "audit",
+         "Audit check-queue cap; overflow drops are counted, never "
+         "block the query path."),
+    Flag("CYCLONUS_AUDIT_SEED", "int", 0, "audit",
+         "Sampler RNG seed (deterministic sampling decisions for a "
+         "fixed query order)."),
+    Flag("CYCLONUS_AUDIT_DIGEST_ROWS", "int", 8, "audit",
+         "Truth-table rows sampled into each epoch digest (seeded off "
+         "the state digest, so replicas sample identical rows)."),
+    Flag("CYCLONUS_AUDIT_EPOCHS", "int", 8, "audit",
+         "Epoch snapshot ring depth: checks older than this many "
+         "committed epochs are dropped as epoch_evicted."),
     # --- harnesses (strip contracts: read ONCE at import) ---------------
     Flag("CYCLONUS_SHAPE_CHECK", "bool", False, "harness",
          "Arm runtime shape-contract checks (utils/contracts.py)."),

@@ -485,6 +485,36 @@ def scenario_serve_routes(ctx: Ctx) -> Dict:
     return {"routes": 3}
 
 
+def scenario_audit_routes(ctx: Ctx) -> Dict:
+    """The audit plane's shadow-oracle check: a sampled verdict from a
+    live service drains through the scalar re-evaluation route."""
+    from cyclonus_tpu.audit import AuditController
+    from cyclonus_tpu.engine import planspec
+    from cyclonus_tpu.serve import VerdictService
+    from cyclonus_tpu.worker.model import FlowQuery
+
+    namespaces = {ns: {"ns": ns} for ns in ("x", "y")}
+    pods = [
+        ("x", "p0", {"app": "a0"}, "10.0.0.1"),
+        ("y", "p1", {"app": "a1"}, "10.0.0.2"),
+    ]
+    svc = VerdictService(
+        pods, namespaces, [],
+        audit=AuditController(rate=1.0, seed=7, start_worker=False),
+    )
+    svc.query([FlowQuery(src="x/p0", dst="y/p1", port=80, protocol="TCP")])
+    ctx.drain()
+    checked = svc.audit.drain()
+    routes = ctx.drain()
+    _check(
+        checked == 1
+        and routes[:1] == [planspec.predict("serve_audit", {})],
+        "serve.audit.check",
+        f"audit check routed {routes} ({checked} checked)",
+    )
+    return {"routes": 1}
+
+
 def scenario_ring_pipelined_route(ctx: Ctx) -> Dict:
     """The donation/feed-forward ring pipeline (coverage: slow — the
     sweep is bench-scale, the route proof is not)."""
@@ -512,6 +542,7 @@ SCENARIOS: List[Tuple[str, Callable[[Ctx], Dict], bool]] = [
     ("ring_family_routes", scenario_ring_family_routes, True),
     ("analysis_routes", scenario_analysis_routes, True),
     ("serve_routes", scenario_serve_routes, True),
+    ("audit_routes", scenario_audit_routes, True),
     ("ring_pipelined_route", scenario_ring_pipelined_route, False),
 ]
 

@@ -58,7 +58,7 @@ class TestRegistryCompleteness:
             assert flag.kind in ("bool", "int", "float", "enum", "str", "path")
             assert flag.owner in (
                 "engine", "serve", "worker", "chaos", "telemetry",
-                "probe", "harness", "cli", "slo",
+                "probe", "harness", "cli", "slo", "audit",
             )
             assert flag.description
             if flag.kind == "enum":

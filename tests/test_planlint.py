@@ -21,7 +21,7 @@ import planlint
 
 DISPATCH_PACKAGES = [
     os.path.join(REPO, "cyclonus_tpu", p)
-    for p in ("engine", "serve", "tiers")
+    for p in ("engine", "serve", "tiers", "audit")
 ]
 
 GOOD_REGISTRY = """

@@ -27,7 +27,7 @@ from cyclonus_tpu.slo import (
     events_over_target,
     state_severity,
 )
-from cyclonus_tpu.slo.objectives import GAUGE, HISTOGRAM, ONCE
+from cyclonus_tpu.slo.objectives import COUNTER, GAUGE, HISTOGRAM, ONCE
 from cyclonus_tpu.telemetry import instruments as ti
 
 
@@ -400,7 +400,9 @@ class TestExportedSurface:
             "enforce", "queue_cap", "ticks", "shed_queries",
             "admission_rejects", "objectives",
         }
-        assert set(snap["objectives"]) == {"query_p99", "freshness", "ttfv"}
+        assert set(snap["objectives"]) == {
+            "query_p99", "freshness", "ttfv", "verdict_integrity",
+        }
         for obj in snap["objectives"].values():
             assert set(obj) == {
                 "signal", "target_s", "budget", "windows", "burn",
@@ -412,7 +414,9 @@ class TestExportedSurface:
 
     def test_declared_objectives_registry(self):
         objs = {o.name: o for o in declared_objectives()}
-        assert list(objs) == ["query_p99", "freshness", "ttfv"]
+        assert list(objs) == [
+            "query_p99", "freshness", "ttfv", "verdict_integrity",
+        ]
         assert objs["query_p99"].kind == HISTOGRAM
         assert (
             objs["query_p99"].signal
@@ -423,6 +427,12 @@ class TestExportedSurface:
             objs["freshness"].signal == "cyclonus_tpu_serve_staleness_seconds"
         )
         assert objs["ttfv"].kind == ONCE
+        assert objs["verdict_integrity"].kind == COUNTER
+        assert (
+            objs["verdict_integrity"].signal
+            == "cyclonus_tpu_audit_diverged_total"
+        )
+        assert objs["verdict_integrity"].enforces == "breach-dump"
 
     def test_objectives_are_env_tunable(self, monkeypatch):
         monkeypatch.setenv("CYCLONUS_SLO_QUERY_P99_S", "0.5")
@@ -463,7 +473,7 @@ class TestSloHttpRoute:
             assert status == 200
             assert body["enforce"] is True
             assert set(body["objectives"]) == {
-                "query_p99", "freshness", "ttfv",
+                "query_p99", "freshness", "ttfv", "verdict_integrity",
             }
             q = body["objectives"]["query_p99"]
             assert {"burn", "budget_remaining", "state"} <= set(q)
@@ -635,7 +645,9 @@ class TestServiceEnforcement:
         svc = mk_service(slo=SloController(enforce=True))
         block = svc.state()["slo"]
         assert block["enforce"] is True
-        assert set(block["objectives"]) == {"query_p99", "freshness", "ttfv"}
+        assert set(block["objectives"]) == {
+            "query_p99", "freshness", "ttfv", "verdict_integrity",
+        }
         for o in block["objectives"].values():
             assert set(o) == {"state", "budget_remaining"}
 

@@ -30,7 +30,8 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
          "cyclonus_tpu/worker", "cyclonus_tpu/analysis",
          "cyclonus_tpu/probe", "cyclonus_tpu/perfobs",
          "cyclonus_tpu/serve", "cyclonus_tpu/tiers", "cyclonus_tpu/chaos",
-         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo"],
+         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo",
+         "cyclonus_tpu/audit"],
         ["cyclonus_tpu/"],
     ),
     ("locklint", ["cyclonus_tpu"], ["cyclonus_tpu/"]),
@@ -39,26 +40,30 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
         ["cyclonus_tpu/engine", "cyclonus_tpu/analysis",
          "cyclonus_tpu/worker/model.py", "cyclonus_tpu/perfobs",
          "cyclonus_tpu/serve", "cyclonus_tpu/tiers", "cyclonus_tpu/chaos",
-         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo"],
+         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo",
+         "cyclonus_tpu/audit"],
         ["cyclonus_tpu/engine", "cyclonus_tpu/analysis",
          "cyclonus_tpu/worker/model.py", "cyclonus_tpu/perfobs",
          "cyclonus_tpu/serve", "cyclonus_tpu/tiers", "cyclonus_tpu/chaos",
-         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo"],
+         "cyclonus_tpu/linter", "cyclonus_tpu/recipes", "cyclonus_tpu/slo",
+         "cyclonus_tpu/audit"],
     ),
     (
         "cachelint",
         ["cyclonus_tpu/engine", "cyclonus_tpu/serve",
-         "cyclonus_tpu/perfobs", "cyclonus_tpu/chaos"],
+         "cyclonus_tpu/perfobs", "cyclonus_tpu/chaos",
+         "cyclonus_tpu/audit"],
         ["cyclonus_tpu/engine", "cyclonus_tpu/serve",
-         "cyclonus_tpu/perfobs", "cyclonus_tpu/chaos"],
+         "cyclonus_tpu/perfobs", "cyclonus_tpu/chaos",
+         "cyclonus_tpu/audit"],
     ),
     (
         "planlint",
         ["--manifest", "artifacts/plan_manifest.json",
          "cyclonus_tpu/engine", "cyclonus_tpu/serve", "cyclonus_tpu/tiers",
-         "cyclonus_tpu/slo"],
+         "cyclonus_tpu/slo", "cyclonus_tpu/audit"],
         ["cyclonus_tpu/engine", "cyclonus_tpu/serve", "cyclonus_tpu/tiers",
-         "cyclonus_tpu/slo", "Makefile", "tests/"],
+         "cyclonus_tpu/slo", "cyclonus_tpu/audit", "Makefile", "tests/"],
     ),
 )
 
