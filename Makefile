@@ -28,9 +28,15 @@ test:
 # dead declarations; docs/DESIGN.md "Plan surface"), cross-checks
 # engine/planspec.py against the dispatch graph and emits the plan
 # manifest artifact.
-# tests/test_cachelint.py pins the five legs under a combined
+# The sixth leg, the authoritative-state lint (tools/statelint.py —
+# guarded-commit-path mutation discipline, rollback-snapshot and
+# digest/note_epoch/state() coverage, epoch-bump discipline, delta-kind
+# lifecycle rows; docs/DESIGN.md "State discipline"), cross-checks
+# serve/stateregistry.py against the service, the wire model, and the
+# audit canonicalization.
+# tests/test_cachelint.py pins the six legs under a combined
 # one-minute wall-clock budget so the gate stays cheap enough to run.
-lint: shapelint cachelint planlint
+lint: shapelint cachelint planlint statelint
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
@@ -55,6 +61,9 @@ planlint:
 	python tools/planlint.py --manifest artifacts/plan_manifest.json \
 	  cyclonus_tpu/engine cyclonus_tpu/serve cyclonus_tpu/tiers \
 	  cyclonus_tpu/slo cyclonus_tpu/audit
+
+statelint:
+	python tools/statelint.py cyclonus_tpu/serve cyclonus_tpu/audit
 
 # git-diff-scoped lint: run only the legs whose scanned paths contain a
 # file changed vs the merge base (falls back to HEAD for a clean tree).
@@ -82,6 +91,19 @@ keyharness:
 # this is the full sweep (adds the slow ring-pipeline leg).
 planharness:
 	JAX_PLATFORMS=cpu python -m tests.planharness --full --verbose
+
+# the state-surface harness (tests/stateharness.py; docs/DESIGN.md
+# "State discipline"): arm the registry call recorder
+# (CYCLONUS_STATEHARNESS=1), drive every registered field's delta kinds
+# through a live VerdictService, and assert the epoch digest changes,
+# a chaos-injected mid-apply failure rolls the digest back through the
+# registry snapshot/restore pair, the epoch advances exactly once per
+# batch, and every declared kind round-trips the wire Delta — plus the
+# forgotten-field legs proving the strict registry surfaces fail
+# loudly.  The quick slice runs in tier-1 via tests/test_statelint.py;
+# this is the full sweep (adds the scaled parity leg).
+stateharness:
+	JAX_PLATFORMS=cpu python -m tests.stateharness --full --verbose
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -220,4 +242,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos slo audit fmt vet lint lint-changed shapelint cachelint planlint keyharness planharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos slo audit fmt vet lint lint-changed shapelint cachelint planlint statelint keyharness planharness stateharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker

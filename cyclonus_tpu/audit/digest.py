@@ -58,7 +58,12 @@ def canonical_state(
     banp: Optional[Any],
 ) -> Dict[str, Any]:
     """The authoritative dicts as a plain, deterministically ordered
-    JSON-able structure (see module docstring for the rules)."""
+    JSON-able structure (see module docstring for the rules).
+
+    The literal keys below are a coverage contract: statelint ST003
+    pins them to the `digest_keys` of every registered StateField in
+    serve/stateregistry.py, so a state field added to the service
+    cannot silently drop out of replica digest equality."""
     return {
         "pods": [
             [p[0], p[1], _canon_labels(p[2]), p[3]]

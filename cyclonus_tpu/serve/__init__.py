@@ -11,8 +11,16 @@ speaks the worker wire protocol's Batch envelope with the optional
 Deltas/Queries/Verdict extensions (worker/model.py).  The differential
 gate — incremental engine vs fresh rebuild vs scalar oracle,
 bit-identical — lives on `VerdictService.verify_parity`.
+
+The authoritative-state surface itself is declarative: `stateregistry`
+registers every state field the service reads (rollback, digest,
+note_epoch, and state() participation plus the delta kinds that may
+touch it), the service mutates through its registry-driven helpers,
+and `tools/statelint.py` cross-checks the two statically
+(docs/DESIGN.md "State discipline").
 """
 
+from . import stateregistry
 from .incremental import IncrementalEngine, Ineligible
 from .loop import run_stdio
 from .service import VerdictService
@@ -22,4 +30,5 @@ __all__ = [
     "Ineligible",
     "VerdictService",
     "run_stdio",
+    "stateregistry",
 ]

@@ -53,6 +53,7 @@ from ..tiers.model import (
 from ..utils import envflags, guards
 from ..utils.tracing import phase
 from ..worker.model import Delta, FlowQuery, Verdict
+from . import stateregistry
 from .incremental import (
     IncrementalEngine,
     Ineligible,
@@ -251,20 +252,19 @@ class VerdictService:
 
     @guards.holds("self._lock")
     def _note_epoch_locked(self) -> None:
-        """Hand the just-committed epoch's state to the audit plane:
-        fresh shallow dict copies are stable snapshots because every
-        apply REPLACES values wholesale (the rollback-snapshot
-        discipline above).  Digest + shadow checks run on the audit
-        worker thread, never here.
+        """Hand the just-committed epoch's state to the audit plane.
+        The field snapshot comes from the state registry
+        (stateregistry.audit_state iterates the declared FIELDS), so a
+        field registered there without a note_epoch parameter fails
+        loudly (TypeError) instead of silently losing digest coverage.
+        Shallow copies are stable snapshots because every apply
+        REPLACES values wholesale (the rollback-snapshot discipline
+        above).  Digest + shadow checks run on the audit worker thread,
+        never here.
 
         holds-lock: self._lock"""
         self._audit.note_epoch(
             self._epoch,
-            pods=dict(self.pods),
-            namespaces=dict(self.namespaces),
-            netpols=dict(self.netpols),
-            anps=dict(self.anps),
-            banp=self.banp,
             policy=self._policy,
             tiers=self._tier_set(),
             config={
@@ -273,6 +273,7 @@ class VerdictService:
                 "anps": len(self.anps),
                 "banp": self.banp is not None,
             },
+            **stateregistry.audit_state(self),
         )
 
     def _tier_set(self) -> Optional[TierSet]:
@@ -540,14 +541,11 @@ class VerdictService:
             # rollback point: every _apply_to_state mutation REPLACES
             # values wholesale (fresh tuples/dicts, never in-place), so
             # shallow copies make the batch atomic — an apply failure
-            # restores these and the batch never happened
-            snap = (
-                dict(self.pods),
-                dict(self.namespaces),
-                dict(self.netpols),
-                dict(self.anps),
-                self.banp,
-            )
+            # restores these and the batch never happened.  The snapshot
+            # iterates the state registry's declared FIELDS, so adding a
+            # field there IS the rollback change (statelint ST002 pins
+            # the pairing with the restore below).
+            snap = stateregistry.snapshot(self)
             ops = []
             try:
                 for d, pol in valid:
@@ -584,13 +582,7 @@ class VerdictService:
                 # so the rebuild succeeds and later batches are clean.
                 import logging
 
-                (
-                    self.pods,
-                    self.namespaces,
-                    self.netpols,
-                    self.anps,
-                    self.banp,
-                ) = snap
+                stateregistry.restore(self, snap)
                 try:
                     self._rebuild()
                 except Exception:
@@ -1057,9 +1049,11 @@ class VerdictService:
                 "degraded_queries": int(ti.SERVE_DEGRADED.value()),
                 "pending_deltas": pending,
                 "staleness_s": round(staleness, 3),
-                "pods": eng.encoding.cluster.n_pods,
-                "namespaces": len(self.namespaces),
-                "policies": len(self.netpols),
+                # every registered field's exposure (pods / namespaces /
+                # policies counts + anps count + banp presence) comes
+                # from the state registry, so a field added there is
+                # visible here without touching this payload
+                **stateregistry.state_counts(self),
                 "applies": dict(self._counts),
                 "last_apply_s": self._last_apply_s,
                 "last_full_rebuild_s": self._last_full_rebuild_s,

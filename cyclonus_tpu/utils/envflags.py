@@ -217,6 +217,9 @@ _FLAGS = [
          "Arm the cache-key mutation recorder (utils/cachekeys.py)."),
     Flag("CYCLONUS_PLANHARNESS", "bool", False, "harness",
          "Arm the dispatch-route recorder (engine/planspec.py)."),
+    Flag("CYCLONUS_STATEHARNESS", "bool", False, "harness",
+         "Arm the state-surface registry call recorder "
+         "(serve/stateregistry.py)."),
 ]
 
 REGISTRY: Dict[str, Flag] = {f.name: f for f in _FLAGS}

@@ -98,7 +98,12 @@ class Delta:
     """One cluster-state mutation for the verdict service
     (cyclonus_tpu/serve): pod add/remove, pod or namespace label change,
     policy create/update/delete.  `kind` selects which optional payload
-    keys are meaningful; unused ones stay unset (omitted on the wire)."""
+    keys are meaningful; unused ones stay unset (omitted on the wire).
+
+    KINDS is one half of a lifecycle contract: every member must carry
+    a KindSpec row in serve/stateregistry.py (validate -> apply ->
+    rollback -> named gate) and vice versa — statelint ST005 and
+    test_worker's registry cross-check both fail on drift."""
 
     KINDS: ClassVar[tuple] = (
         "pod_add",       # Namespace/Name + Labels + Ip

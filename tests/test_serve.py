@@ -570,6 +570,30 @@ class TestQueries:
         assert st["query_latency"]["p50_s"] is not None
         assert st["query_latency"]["p99_s"] >= st["query_latency"]["p50_s"]
 
+    def test_state_payload_covers_every_registered_field(self):
+        """state() counts come from stateregistry.state_counts, so every
+        registered authoritative field — including the tier objects the
+        payload used to omit — is visible for operator introspection."""
+        from cyclonus_tpu.serve import stateregistry
+        from cyclonus_tpu.tiers.model import (
+            AdminNetworkPolicy, TierRule, TierScope,
+        )
+
+        pods, namespaces = mk_cluster(6)
+        svc = VerdictService(pods, namespaces, [])
+        st = svc.state()
+        for field in stateregistry.FIELDS:
+            assert field.state_key in st, field.state_key
+        assert st["pods"] == 6 and st["anps"] == 0 and st["banp"] is False
+        anp = AdminNetworkPolicy(
+            name="t", priority=1, subject=TierScope(),
+            ingress=[TierRule(action="Deny", peers=[TierScope()])],
+        )
+        svc.submit([Delta(kind="anp_upsert", name="t",
+                          policy=anp.to_dict())])
+        svc.apply_pending()
+        assert svc.state()["anps"] == 1
+
 
 class TestWireLoop:
     def test_stdio_roundtrip_in_process(self):

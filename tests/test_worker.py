@@ -309,6 +309,32 @@ class TestWireCompatibility:
         assert findings == [], "\n".join(f.render() for f in findings)
         assert stats["contracts"] >= 15, stats  # 3 WIRE maps
 
+    def test_registry_delta_kinds_all_on_the_wire(self):
+        """Every delta Kind the state registry declares (and that
+        statelint's ST005 lifecycle check walks) must be a member of
+        the wire model's Delta.KINDS — and vice versa, so a Kind added
+        to the wire cannot ship without a registry lifecycle row.  This
+        is the wire-side twin of statelint's registry-vs-model check:
+        it fails in plain pytest even when the lint legs don't run."""
+        from cyclonus_tpu.serve import stateregistry
+        from cyclonus_tpu.worker.model import Delta
+
+        registry_kinds = set(stateregistry.delta_kinds())
+        wire_kinds = set(Delta.KINDS)
+        missing_on_wire = registry_kinds - wire_kinds
+        assert not missing_on_wire, (
+            f"registry declares kinds absent from Delta.KINDS: "
+            f"{sorted(missing_on_wire)}"
+        )
+        unregistered = wire_kinds - registry_kinds
+        assert not unregistered, (
+            f"Delta.KINDS carries kinds with no stateregistry "
+            f"lifecycle row: {sorted(unregistered)}"
+        )
+        # the registry is the union of its per-field kind tuples
+        per_field = {k for f in stateregistry.FIELDS for k in f.kinds}
+        assert per_field == registry_kinds
+
 
 class _FakeProc:
     def __init__(self, returncode=0, stdout="CONNECTED", stderr=""):

@@ -65,6 +65,14 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
         ["cyclonus_tpu/engine", "cyclonus_tpu/serve", "cyclonus_tpu/tiers",
          "cyclonus_tpu/slo", "cyclonus_tpu/audit", "Makefile", "tests/"],
     ),
+    (
+        # registry-level leg like planlint: the ST003/ST005 checks read
+        # the wire model, the Makefile, and tests/ gate files directly
+        "statelint",
+        ["cyclonus_tpu/serve", "cyclonus_tpu/audit"],
+        ["cyclonus_tpu/serve", "cyclonus_tpu/audit",
+         "cyclonus_tpu/worker/model.py", "Makefile", "tests/"],
+    ),
 )
 
 

@@ -1,0 +1,1102 @@
+"""statelint: authoritative-state & epoch-discipline verifier.
+
+The sixth linter leg (jaxlint / locklint / shapelint / cachelint /
+planlint / statelint — shared scaffolding in tools/lintcore.py).  The
+runtime twin is cyclonus_tpu/serve/stateregistry.py: a declarative
+registry of authoritative-state fields (StateField), delta-kind
+lifecycle rows (KindSpec), and the guarded commit-path contract
+(COMMIT) that VerdictService's commit path actually reads.  statelint
+extracts the registry from the AST (no import — a package syntax error
+cannot take the linter down) and cross-checks it against the scanned
+serve/ + audit/ modules, worker/model.py's wire Delta.KINDS, and
+audit/digest.py's canonicalization:
+
+  ST001  registered state field mutated outside the guarded commit
+         path (not under the declared lock, not lock-covered by
+         one-level call inference, not construction), or the commit
+         path applies deltas before their validator runs.
+  ST002  registered field missing from the apply_pending rollback
+         snapshot or its restore (an apply failure would commit
+         poison); the registry-driven snapshot/restore helpers are
+         fully covered by construction.
+  ST003  field absent from audit/digest.py's canonical_state, from the
+         note_epoch snapshot, or from the state() payload (replica
+         digest equality silently loses coverage).
+  ST004  epoch-bump discipline: the commit path increments the epoch
+         exactly once, under the lock, after all mutations; no other
+         function bumps it; no epoch read pairs with state reads
+         outside a consistent (locked) snapshot.
+  ST005  delta Kind without full lifecycle coverage — wire (a
+         Delta.KINDS member), validate (the validator vets kind
+         membership), apply (the applier names the kind), rollback
+         (the owning field snapshots), and a named existing test gate
+         — or a wire kind with no declared lifecycle row at all.
+
+Suppress a finding with `# statelint: ignore[ST00X]` on the offending
+line.
+
+Run: python tools/statelint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli, suppress
+
+_IGNORE_RE = ignore_regex("statelint")
+
+DEFAULT_PATHS = [
+    "cyclonus_tpu/serve",
+    "cyclonus_tpu/audit",
+]
+
+REGISTRY_BASENAME = "stateregistry.py"
+
+#: dict-mutating method calls on a registered field attribute
+_MUTATING_METHODS = {
+    "update", "clear", "pop", "popitem", "setdefault", "extend", "append",
+}
+
+
+# --------------------------------------------------------------------------
+# Registry extraction (planlint's discipline: literal StateField(...) /
+# KindSpec(...) calls and the COMMIT literal dict, read off the AST).
+# --------------------------------------------------------------------------
+
+@dataclass
+class FieldDecl:
+    name: str
+    attr: str
+    container: str
+    kinds: Tuple[str, ...]
+    digest_key: str
+    state_key: str
+    rollback: bool
+    line: int
+    fields: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class KindDecl:
+    kind: str
+    field: str
+    gate: str
+    payload: str
+    line: int
+    fields: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class Registry:
+    path: str = ""
+    fields: List[FieldDecl] = field(default_factory=list)
+    kinds: List[KindDecl] = field(default_factory=list)
+    commit: Dict[str, str] = field(default_factory=dict)
+
+    def field_by_name(self, name: str) -> Optional[FieldDecl]:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        return None
+
+    def attrs(self) -> Dict[str, FieldDecl]:
+        return {f.attr: f for f in self.fields}
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _call_kwargs(call: ast.Call, positional: List[str]) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for i, arg in enumerate(call.args):
+        if i < len(positional):
+            out[positional[i]] = _literal(arg)
+    for kw in call.keywords:
+        if kw.arg:
+            out[kw.arg] = _literal(kw.value)
+    return out
+
+
+def load_registry(registry_path: str) -> Optional[Registry]:
+    try:
+        with open(registry_path, "r") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    reg = Registry(path=registry_path)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tgts = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in tgts:
+                if isinstance(tgt, ast.Name) and tgt.id == "COMMIT":
+                    val = _literal(node.value) if node.value else None
+                    if isinstance(val, dict):
+                        reg.commit = {str(k): str(v) for k, v in val.items()}
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+        if name == "StateField":
+            kw = _call_kwargs(node, ["name"])
+            reg.fields.append(FieldDecl(
+                name=str(kw.get("name") or ""),
+                attr=str(kw.get("attr") or kw.get("name") or ""),
+                container=str(kw.get("container") or "dict"),
+                kinds=tuple(kw.get("kinds") or ()),
+                digest_key=str(kw.get("digest_key") or ""),
+                state_key=str(kw.get("state_key") or ""),
+                rollback=bool(kw.get("rollback", True)),
+                line=node.lineno,
+                fields=kw,
+            ))
+        elif name == "KindSpec":
+            kw = _call_kwargs(node, ["kind"])
+            reg.kinds.append(KindDecl(
+                kind=str(kw.get("kind") or ""),
+                field=str(kw.get("field") or ""),
+                gate=str(kw.get("gate") or ""),
+                payload=str(kw.get("payload") or ""),
+                line=node.lineno,
+                fields=kw,
+            ))
+    return reg
+
+
+def find_registry(paths: List[str]) -> Optional[str]:
+    """Locate stateregistry.py: inside a scanned directory, else
+    relative to the repo root the scanned paths live under."""
+    for p in paths:
+        if os.path.isdir(p):
+            cand = os.path.join(p, REGISTRY_BASENAME)
+            if os.path.exists(cand):
+                return cand
+        elif os.path.basename(p) == REGISTRY_BASENAME:
+            return p
+    anchor = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+    for _ in range(6):
+        cand = os.path.join(
+            cur, "cyclonus_tpu", "serve", REGISTRY_BASENAME
+        )
+        if os.path.exists(cand):
+            return cand
+        cur = os.path.dirname(cur)
+    return None
+
+
+def _repo_root_for(registry_path: str) -> str:
+    # .../cyclonus_tpu/serve/stateregistry.py -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(registry_path)
+    )))
+
+
+def _gate_exists(gate: str, root: str) -> bool:
+    if gate.startswith("tests/"):
+        return os.path.exists(os.path.join(root, gate))
+    if gate.startswith("make "):
+        target = gate.split(None, 1)[1]
+        mk = os.path.join(root, "Makefile")
+        if not os.path.exists(mk):
+            return False
+        with open(mk) as f:
+            return re.search(
+                rf"^{re.escape(target)}:", f.read(), re.MULTILINE
+            ) is not None
+    return False
+
+
+# --------------------------------------------------------------------------
+# Wire + digest side extraction.
+# --------------------------------------------------------------------------
+
+def load_wire_kinds(root: str) -> Optional[Tuple[Set[str], str, int]]:
+    """Delta.KINDS from worker/model.py's AST: (kinds, path, lineno), or
+    None when the model module is absent (scratch fixture trees)."""
+    path = os.path.join(root, "cyclonus_tpu", "worker", "model.py")
+    try:
+        with open(path, "r") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or node.name != "Delta":
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                tgts = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for tgt in tgts:
+                    if isinstance(tgt, ast.Name) and tgt.id == "KINDS":
+                        val = _literal(sub.value)
+                        if isinstance(val, tuple):
+                            return set(val), path, sub.lineno
+    return None
+
+
+def load_digest_keys(root: str) -> Optional[Tuple[Set[str], str, int]]:
+    """canonical_state's literal return-dict keys from audit/digest.py:
+    (keys, path, lineno), or None when the digest module is absent."""
+    path = os.path.join(root, "cyclonus_tpu", "audit", "digest.py")
+    try:
+        with open(path, "r") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name != "canonical_state":
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and isinstance(
+                sub.value, ast.Dict
+            ):
+                keys = {
+                    k.value for k in sub.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str
+                    )
+                }
+                return keys, path, node.lineno
+    return None
+
+
+# --------------------------------------------------------------------------
+# Service-class analysis: mutations, reads, call edges, lock context.
+# --------------------------------------------------------------------------
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+_HOLDS_DOC_RE = re.compile(r"holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def _declared_holds(func: ast.AST) -> Set[str]:
+    """Locks a function declares held: docstring `holds-lock: expr` and
+    `@guards.holds("expr")` decorators (the locklint convention)."""
+    out: Set[str] = set()
+    doc = ast.get_docstring(func, clean=False) or ""
+    out.update(_HOLDS_DOC_RE.findall(doc))
+    for dec in getattr(func, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            name = (
+                dec.func.attr if isinstance(dec.func, ast.Attribute)
+                else dec.func.id if isinstance(dec.func, ast.Name) else None
+            )
+            if name == "holds":
+                for a in dec.args:
+                    if isinstance(a, ast.Constant) and isinstance(
+                        a.value, str
+                    ):
+                        out.add(a.value)
+    return out
+
+
+@dataclass
+class Site:
+    """One mutation / read / call / bump site with its lock context."""
+    attr: str
+    line: int
+    col: int
+    in_lock: bool
+    func: str
+
+
+@dataclass
+class ServiceModel:
+    """Everything statelint needs about one service class."""
+    path: str = ""
+    cls: str = ""
+    mutations: List[Site] = field(default_factory=list)
+    epoch_bumps: List[Site] = field(default_factory=list)
+    epoch_reads: List[Site] = field(default_factory=list)
+    field_reads: List[Site] = field(default_factory=list)
+    call_edges: List[Site] = field(default_factory=list)  # attr=callee
+    entry_holds: Dict[str, bool] = field(default_factory=dict)
+    funcs: Dict[str, ast.AST] = field(default_factory=dict)
+    registry_calls: List[Tuple[str, int, str]] = field(default_factory=list)
+
+
+class _FuncWalker:
+    """One function's lexical lock-context walk.  `held` tracks whether
+    the declared lock is held at each statement (entry holds + nested
+    `with self._lock:` blocks)."""
+
+    def __init__(self, model: ServiceModel, func: ast.AST, lock_expr: str,
+                 field_attrs: Set[str], epoch_attr: str):
+        self.model = model
+        self.func = func
+        self.lock = lock_expr
+        self.field_attrs = field_attrs
+        self.epoch = epoch_attr
+        self.entry = lock_expr in _declared_holds(func)
+        model.entry_holds[func.name] = self.entry
+
+    def run(self) -> None:
+        for stmt in self.func.body:
+            self._visit(stmt, self.entry)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _site(self, attr: str, node: ast.AST, held: bool) -> Site:
+        return Site(attr, node.lineno, node.col_offset, held,
+                    self.func.name)
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _target_attrs(self, tgt: ast.AST) -> List[str]:
+        """Registered/epoch attrs a statement target mutates: plain
+        `self.x`, `self.x[...]`, and tuple targets."""
+        out: List[str] = []
+        if isinstance(tgt, ast.Tuple):
+            for el in tgt.elts:
+                out.extend(self._target_attrs(el))
+            return out
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value
+        attr = self._self_attr(tgt)
+        if attr is not None:
+            out.append(attr)
+        return out
+
+    def _scan_expr(self, node: ast.AST, held: bool) -> None:
+        """Reads + mutating method calls + self-call edges + registry
+        helper calls inside one expression/statement subtree."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                if isinstance(fn, ast.Attribute):
+                    chain = _attr_chain(fn)
+                    # self._method(...) edge for one-level inference
+                    owner = self._self_attr(fn.value)
+                    if (
+                        isinstance(fn.value, ast.Name)
+                        and fn.value.id == "self"
+                    ):
+                        self.model.call_edges.append(
+                            self._site(fn.attr, sub, held)
+                        )
+                    # mutating dict-method call on a registered field
+                    if (
+                        owner in self.field_attrs
+                        and fn.attr in _MUTATING_METHODS
+                    ):
+                        self.model.mutations.append(
+                            self._site(owner, sub, held)
+                        )
+                    # registry helper call (stateregistry.snapshot etc.)
+                    root, _, leaf = chain.rpartition(".")
+                    if root.endswith("stateregistry") or root == "":
+                        if leaf in ("snapshot", "restore", "audit_state",
+                                    "state_counts"):
+                            self.model.registry_calls.append(
+                                (leaf, sub.lineno, self.func.name)
+                            )
+            if isinstance(sub, ast.Attribute) and isinstance(
+                sub.ctx, ast.Load
+            ):
+                attr = self._self_attr(sub)
+                if attr in self.field_attrs:
+                    self.model.field_reads.append(
+                        self._site(attr, sub, held)
+                    )
+                elif attr == self.epoch:
+                    self.model.epoch_reads.append(
+                        self._site(attr, sub, held)
+                    )
+
+    # -- traversal ---------------------------------------------------------
+
+    def _visit(self, stmt: ast.AST, held: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run at call time, not under this lock
+        if isinstance(stmt, ast.With):
+            inner = held
+            for item in stmt.items:
+                if _attr_chain(item.context_expr) == self.lock:
+                    inner = True
+                self._scan_expr(item.context_expr, held)
+            for s in stmt.body:
+                self._visit(s, inner)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for tgt in tgts:
+                for attr in self._target_attrs(tgt):
+                    if attr in self.field_attrs:
+                        self.model.mutations.append(
+                            self._site(attr, stmt, held)
+                        )
+                    elif attr == self.epoch:
+                        self.model.epoch_bumps.append(
+                            self._site(attr, stmt, held)
+                        )
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                for attr in self._target_attrs(tgt):
+                    if attr in self.field_attrs:
+                        self.model.mutations.append(
+                            self._site(attr, stmt, held)
+                        )
+            return
+        # compound statements: recurse into bodies with the same held
+        # flag, scan the tests/expressions for reads
+        for fld in ("test", "iter", "value", "exc"):
+            sub = getattr(stmt, fld, None)
+            if isinstance(sub, ast.AST):
+                self._scan_expr(sub, held)
+        for fld in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, fld, []) or []:
+                self._visit(s, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for s in handler.body:
+                self._visit(s, held)
+
+
+def scan_service_class(path: str, cls: ast.ClassDef, commit: Dict[str, str],
+                       field_attrs: Set[str]) -> ServiceModel:
+    model = ServiceModel(path=path, cls=cls.name)
+    lock = commit.get("lock", "self._lock")
+    epoch = commit.get("epoch_attr", "_epoch")
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.funcs[node.name] = node
+            _FuncWalker(model, node, lock, field_attrs, epoch).run()
+    return model
+
+
+def _lock_covered(model: ServiceModel, func: str) -> bool:
+    """One-level call inference: a function is lock-covered when it
+    declares holds, or when every scanned call site of it sits in lock
+    context (and at least one exists)."""
+    if model.entry_holds.get(func):
+        return True
+    sites = [e for e in model.call_edges if e.attr == func]
+    return bool(sites) and all(e.in_lock for e in sites)
+
+
+# --------------------------------------------------------------------------
+# The lint proper.
+# --------------------------------------------------------------------------
+
+def _calls_of(func: ast.AST, name: str) -> List[ast.Call]:
+    out = []
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            leaf = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if leaf == name:
+                out.append(sub)
+    return out
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    """`self.<attr>` attribute names referenced anywhere in a subtree."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            out.add(sub.attr)
+    return out
+
+
+def _string_constants(node: ast.AST) -> Set[str]:
+    return {
+        sub.value for sub in ast.walk(node)
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+    }
+
+
+def _has_kinds_membership(func: ast.AST) -> bool:
+    """Does the validator vet kind membership against the wire KINDS
+    tuple (`d.kind not in Delta.KINDS` / `... in KINDS`)?"""
+    for sub in ast.walk(func):
+        if not isinstance(sub, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.In, ast.NotIn)) for op in sub.ops):
+            continue
+        for cmp in sub.comparators:
+            if _attr_chain(cmp).endswith("KINDS"):
+                return True
+    return False
+
+
+def _double_star_covered(call: ast.Call, leaf: str) -> bool:
+    """Does the call carry `**<...>.<leaf>(...)` (the registry-driven
+    kwarg form)?"""
+    for kw in call.keywords:
+        if kw.arg is not None:
+            continue
+        for sub in ast.walk(kw.value):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                name = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if name == leaf:
+                    return True
+    return False
+
+
+def _commit_checks(model: ServiceModel, reg: Registry,
+                   findings: List[Finding]) -> None:
+    """ST001 (validator ordering), ST002 (snapshot/restore), ST004
+    (epoch bump discipline) over the declared commit function."""
+    commit_name = reg.commit.get("commit", "apply_pending")
+    validator = reg.commit.get("validator", "_validate_delta")
+    applier = reg.commit.get("applier", "_apply_to_state")
+    func = model.funcs.get(commit_name)
+    if func is None:
+        return
+    path = model.path
+    rollback_fields = [f for f in reg.fields if f.rollback]
+
+    applier_calls = _calls_of(func, applier)
+    validator_calls = _calls_of(func, validator)
+    if applier_calls:
+        first_apply = min(c.lineno for c in applier_calls)
+        if not validator_calls:
+            findings.append(Finding(
+                path, first_apply, applier_calls[0].col_offset, "ST001",
+                f"commit path {commit_name!r} applies deltas without "
+                f"calling the declared validator {validator!r}",
+            ))
+        elif min(c.lineno for c in validator_calls) > first_apply:
+            findings.append(Finding(
+                path, first_apply, applier_calls[0].col_offset, "ST001",
+                f"commit path {commit_name!r} mutates state (via "
+                f"{applier!r}) before its validator {validator!r} runs",
+            ))
+
+    # -- ST002: the rollback snapshot + restore ---------------------------
+    reg_snapshot = [
+        (line, fn) for op, line, fn in model.registry_calls
+        if op == "snapshot" and fn == commit_name
+    ]
+    reg_restore = [
+        (line, fn) for op, line, fn in model.registry_calls
+        if op == "restore" and fn == commit_name
+    ]
+    if reg_snapshot:
+        # registry-driven snapshot: covered by construction; the restore
+        # must be registry-driven too
+        if not reg_restore:
+            findings.append(Finding(
+                path, reg_snapshot[0][0], 0, "ST002",
+                f"commit path {commit_name!r} takes the registry "
+                f"snapshot but never calls stateregistry.restore on "
+                f"failure",
+            ))
+    else:
+        # literal snapshot: the assignment referencing the most
+        # registered attrs is the rollback point; every rollback field
+        # must appear in it (and in the restore target)
+        snap_assign = None
+        snap_cover: Set[str] = set()
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Assign) and sub.value is not None:
+                names = _names_in(sub.value) & {
+                    f.attr for f in rollback_fields
+                }
+                if len(names) > len(snap_cover):
+                    snap_assign, snap_cover = sub, names
+        if snap_assign is None:
+            if applier_calls:
+                findings.append(Finding(
+                    path, func.lineno, func.col_offset, "ST002",
+                    f"commit path {commit_name!r} takes no rollback "
+                    f"snapshot before applying deltas",
+                ))
+        else:
+            for f in rollback_fields:
+                if f.attr not in snap_cover:
+                    findings.append(Finding(
+                        path, snap_assign.lineno, snap_assign.col_offset,
+                        "ST002",
+                        f"registered state field {f.name!r} "
+                        f"(self.{f.attr}) is missing from the rollback "
+                        f"snapshot",
+                    ))
+            restore_cover: Set[str] = set()
+            for sub in ast.walk(func):
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Tuple):
+                            names = _names_in(tgt) & {
+                                f.attr for f in rollback_fields
+                            }
+                            if len(names) > len(restore_cover):
+                                restore_cover = names
+            for f in rollback_fields:
+                if f.attr in snap_cover and f.attr not in restore_cover:
+                    findings.append(Finding(
+                        path, snap_assign.lineno, snap_assign.col_offset,
+                        "ST002",
+                        f"registered state field {f.name!r} "
+                        f"(self.{f.attr}) is snapshotted but never "
+                        f"restored on apply failure",
+                    ))
+
+    # -- ST004: the epoch bump --------------------------------------------
+    epoch = reg.commit.get("epoch_attr", "_epoch")
+    commit_bumps = [
+        b for b in model.epoch_bumps
+        if b.func == commit_name
+    ]
+    if applier_calls and not commit_bumps:
+        findings.append(Finding(
+            path, func.lineno, func.col_offset, "ST004",
+            f"commit path {commit_name!r} never increments the epoch "
+            f"(self.{epoch})",
+        ))
+    elif len(commit_bumps) > 1:
+        for b in commit_bumps[1:]:
+            findings.append(Finding(
+                path, b.line, b.col, "ST004",
+                f"commit path {commit_name!r} increments the epoch "
+                f"{len(commit_bumps)} times (want exactly once)",
+            ))
+    if commit_bumps:
+        b = commit_bumps[0]
+        if not b.in_lock:
+            findings.append(Finding(
+                path, b.line, b.col, "ST004",
+                f"epoch bump in {commit_name!r} is outside the "
+                f"declared lock ({reg.commit.get('lock')})",
+            ))
+        mut_lines = [
+            m.line for m in model.mutations if m.func == commit_name
+        ] + [c.lineno for c in applier_calls]
+        late = [ln for ln in mut_lines if ln > b.line]
+        if late:
+            findings.append(Finding(
+                path, b.line, b.col, "ST004",
+                f"epoch bump in {commit_name!r} runs before state "
+                f"mutations complete (mutation at line {min(late)})",
+            ))
+
+
+def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, object]]:
+    files = iter_py_files(paths)
+    registry_path = find_registry(paths)
+    findings: List[Finding] = []
+    empty_stats = {
+        "files": len(files), "fields": 0, "kinds": 0, "annotations": 0,
+        "findings": 1,
+    }
+    if registry_path is None:
+        findings.append(Finding(
+            paths[0] if paths else ".", 0, 0, "ST001",
+            "cyclonus_tpu/serve/stateregistry.py not found: the "
+            "authoritative-state surface has no declared registry to "
+            "lint against",
+        ))
+        return findings, empty_stats
+    reg = load_registry(registry_path)
+    if reg is None or not reg.fields:
+        findings.append(Finding(
+            registry_path, 0, 0, "ST001",
+            "state registry unparseable or empty",
+        ))
+        return findings, empty_stats
+
+    root = _repo_root_for(registry_path)
+    wire = load_wire_kinds(root)
+    digest = load_digest_keys(root)
+    field_attrs = set(reg.attrs())
+    commit_cls = reg.commit.get("class", "VerdictService")
+    commit_name = reg.commit.get("commit", "apply_pending")
+    validator_name = reg.commit.get("validator", "_validate_delta")
+    applier_name = reg.commit.get("applier", "_apply_to_state")
+    note_name = reg.commit.get("audit_note", "note_epoch")
+
+    models: List[ServiceModel] = []
+    annotations = len(reg.fields) + len(reg.kinds)
+    note_sites: List[Tuple[str, ast.Call, List[str]]] = []
+    state_funcs: List[Tuple[str, ast.AST, List[str]]] = []
+
+    for path in files:
+        if os.path.basename(path) == REGISTRY_BASENAME:
+            continue  # the registry itself is not a mutation site
+        try:
+            with open(path, "r") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            findings.append(Finding(path, 0, 0, "ST000", "syntax error"))
+            continue
+        lines = source.splitlines()
+        file_findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            defines_commit = any(
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name == commit_name
+                for n in node.body
+            )
+            if node.name != commit_cls and not defines_commit:
+                continue
+            model = scan_service_class(path, node, reg.commit, field_attrs)
+            models.append(model)
+            annotations += len(model.registry_calls)
+
+            # ST001: mutations outside the guarded commit path
+            for m in model.mutations:
+                if m.func == "__init__":
+                    continue  # construction precedes concurrency
+                if m.in_lock or _lock_covered(model, m.func):
+                    continue
+                fdecl = reg.attrs()[m.attr]
+                file_findings.append(Finding(
+                    path, m.line, m.col, "ST001",
+                    f"state field {fdecl.name!r} (self.{m.attr}) mutated "
+                    f"outside the guarded commit path in {m.func!r} "
+                    f"(not under {reg.commit.get('lock')}, not "
+                    f"lock-covered by its call sites)",
+                ))
+
+            # ST004: epoch bumps outside the commit function
+            for b in model.epoch_bumps:
+                if b.func in (commit_name, "__init__"):
+                    continue
+                file_findings.append(Finding(
+                    path, b.line, b.col, "ST004",
+                    f"epoch (self.{reg.commit.get('epoch_attr')}) "
+                    f"mutated outside the commit path, in {b.func!r}",
+                ))
+
+            # ST004: epoch read paired with state outside the lock
+            flagged: Set[str] = set()
+            for er in model.epoch_reads:
+                if er.in_lock or er.func in flagged:
+                    continue
+                if _lock_covered(model, er.func):
+                    continue
+                paired = [
+                    fr for fr in model.field_reads
+                    if fr.func == er.func and not fr.in_lock
+                ]
+                if paired:
+                    flagged.add(er.func)
+                    file_findings.append(Finding(
+                        path, er.line, er.col, "ST004",
+                        f"epoch read paired with state field "
+                        f"{paired[0].attr!r} in {er.func!r} outside a "
+                        f"consistent locked snapshot",
+                    ))
+
+            # ST001/ST002/ST004 over the commit function itself
+            _commit_checks(model, reg, file_findings)
+
+        # note_epoch call sites + state() payloads (ST003, checked after
+        # the scan so registry-call coverage is known)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr == note_name:
+                    kwargs = [
+                        kw.arg for kw in node.keywords if kw.arg
+                    ]
+                    note_sites.append((path, node, kwargs))
+            elif isinstance(node, ast.FunctionDef) and node.name == "state":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and isinstance(
+                        sub.value, ast.Dict
+                    ):
+                        keys = [
+                            k.value for k in sub.value.keys
+                            if isinstance(k, ast.Constant)
+                        ]
+                        covered = any(
+                            k is None and any(
+                                isinstance(c, ast.Call) and (
+                                    getattr(c.func, "attr", "")
+                                    or getattr(c.func, "id", "")
+                                ) == "state_counts"
+                                for c in ast.walk(v)
+                            )
+                            for k, v in zip(
+                                sub.value.keys, sub.value.values
+                            )
+                        )
+                        state_funcs.append((
+                            path, sub,
+                            ["*"] if covered else keys,
+                        ))
+        findings.extend(suppress(file_findings, lines, _IGNORE_RE))
+
+    # ST003 over audit call sites: every field must ride note_epoch
+    st3: Dict[str, List[Finding]] = {}
+    for path, call, kwargs in note_sites:
+        if _double_star_covered(call, "audit_state"):
+            continue  # registry-driven; counted via registry_calls
+        missing = [
+            f.name for f in reg.fields if f.name not in kwargs
+        ]
+        for name in missing:
+            st3.setdefault(path, []).append(Finding(
+                path, call.lineno, call.col_offset, "ST003",
+                f"registered state field {name!r} is missing from the "
+                f"{note_name} snapshot",
+            ))
+    for path, ret, keys in state_funcs:
+        if keys == ["*"]:
+            continue
+        for f in reg.fields:
+            if f.state_key and f.state_key not in keys:
+                st3.setdefault(path, []).append(Finding(
+                    path, ret.value.lineno, ret.value.col_offset, "ST003",
+                    f"registered state field {f.name!r} (key "
+                    f"{f.state_key!r}) is missing from the state() "
+                    f"payload",
+                ))
+    for path, fl in st3.items():
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        findings.extend(suppress(fl, lines, _IGNORE_RE))
+
+    # ST003 digest coverage + registry-side ST005, anchored at the
+    # declaration lines (the registry/digest files' own ignore comments
+    # apply)
+    reg_findings: List[Finding] = []
+    digest_findings: List[Finding] = []
+    if digest is not None:
+        dkeys, dpath, dline = digest
+        for f in reg.fields:
+            if f.digest_key and f.digest_key not in dkeys:
+                digest_findings.append(Finding(
+                    dpath, dline, 0, "ST003",
+                    f"registered state field {f.name!r} (key "
+                    f"{f.digest_key!r}) is missing from "
+                    f"canonical_state: replica digest equality would "
+                    f"silently lose coverage",
+                ))
+            elif f.digest_key:
+                annotations += 1  # live digest-surface participation
+        try:
+            with open(dpath) as fh:
+                dlines = fh.read().splitlines()
+        except OSError:
+            dlines = []
+        findings.extend(suppress(digest_findings, dlines, _IGNORE_RE))
+
+    declared_kinds = {k.kind for k in reg.kinds}
+    validator_func = None
+    applier_func = None
+    for model in models:
+        validator_func = validator_func or model.funcs.get(validator_name)
+        applier_func = applier_func or model.funcs.get(applier_name)
+    applier_kinds = (
+        _string_constants(applier_func) if applier_func is not None
+        else None
+    )
+    validator_vets = (
+        validator_func is None or _has_kinds_membership(validator_func)
+    )
+    for k in reg.kinds:
+        owner = reg.field_by_name(k.field)
+        if owner is None:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} declares unknown owning field "
+                f"{k.field!r}",
+            ))
+            continue
+        if k.kind not in owner.kinds:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} is not listed in field "
+                f"{owner.name!r}'s kinds tuple",
+            ))
+        if wire is not None and k.kind not in wire[0]:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} has no wire Delta kind "
+                f"(worker/model.py Delta.KINDS): it cannot round-trip",
+            ))
+        if validator_func is not None and not validator_vets:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r}: the validator "
+                f"{validator_name!r} never vets kind membership "
+                f"against Delta.KINDS",
+            ))
+        if applier_kinds is not None and k.kind not in applier_kinds:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} is never applied: the applier "
+                f"{applier_name!r} does not name it",
+            ))
+        if not owner.rollback:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} mutates field {owner.name!r} "
+                f"which opts out of the rollback snapshot",
+            ))
+        if not k.gate:
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} declares no lifecycle gate",
+            ))
+        elif not _gate_exists(k.gate, root):
+            reg_findings.append(Finding(
+                reg.path, k.line, 0, "ST005",
+                f"delta kind {k.kind!r} gate {k.gate!r} does not exist "
+                f"(want an existing tests/ file or make target)",
+            ))
+    for f in reg.fields:
+        for kind in f.kinds:
+            if kind not in declared_kinds:
+                reg_findings.append(Finding(
+                    reg.path, f.line, 0, "ST005",
+                    f"field {f.name!r} kind {kind!r} has no declared "
+                    f"KindSpec lifecycle row",
+                ))
+    try:
+        with open(reg.path) as f:
+            reg_lines = f.read().splitlines()
+    except OSError:
+        reg_lines = []
+    findings.extend(suppress(reg_findings, reg_lines, _IGNORE_RE))
+
+    # the reverse wire check: a Delta.KINDS member with no lifecycle row
+    if wire is not None:
+        wkinds, wpath, wline = wire
+        wire_findings = [
+            Finding(
+                wpath, wline, 0, "ST005",
+                f"wire delta kind {kind!r} has no KindSpec lifecycle "
+                f"row in the state registry",
+            )
+            for kind in sorted(wkinds - declared_kinds)
+        ]
+        if wire_findings:
+            try:
+                with open(wpath) as f:
+                    wlines = f.read().splitlines()
+            except OSError:
+                wlines = []
+            findings.extend(suppress(wire_findings, wlines, _IGNORE_RE))
+
+    stats = {
+        "files": len(files),
+        "fields": len(reg.fields),
+        "kinds": len(reg.kinds),
+        "annotations": annotations,
+        "findings": len(findings),
+        "registry": reg,
+        "registry_path": registry_path,
+    }
+    stats["findings"] = len(findings)
+    return (
+        sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)),
+        stats,
+    )
+
+
+# --------------------------------------------------------------------------
+# Manifest (pinned byte-identical to stateregistry.manifest()).
+# --------------------------------------------------------------------------
+
+def build_manifest(reg: Registry) -> Dict:
+    return {
+        "version": 1,
+        "fields": [
+            {
+                "name": f.name,
+                "attr": f.attr,
+                "container": f.container,
+                "kinds": list(f.kinds),
+                "digest_key": f.digest_key,
+                "state_key": f.state_key,
+                "rollback": f.rollback,
+                "note": str(f.fields.get("note") or ""),
+            }
+            for f in reg.fields
+        ],
+        "kinds": [
+            {
+                "kind": k.kind,
+                "field": k.field,
+                "gate": k.gate,
+                "payload": k.payload,
+                "note": str(k.fields.get("note") or ""),
+            }
+            for k in reg.kinds
+        ],
+        "commit": dict(reg.commit),
+    }
+
+
+def _post(args, findings, stats) -> None:
+    stats.pop("registry", None)
+    stats.pop("registry_path", None)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run_cli(
+        "statelint",
+        __doc__,
+        lint_paths,
+        DEFAULT_PATHS,
+        lambda findings, stats: (
+            f"statelint: {len(findings)} finding(s), "
+            f"{stats['fields']} field / {stats['kinds']} kind "
+            f"declaration(s), {stats['annotations']} live annotation(s) "
+            f"in {stats['files']} file(s)"
+        ),
+        argv,
+        post=_post,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
