@@ -34,9 +34,16 @@ test:
 # lifecycle rows; docs/DESIGN.md "State discipline"), cross-checks
 # serve/stateregistry.py against the service, the wire model, and the
 # audit canonicalization.
-# tests/test_cachelint.py pins the six legs under a combined
+# The seventh leg, the wire-protocol compatibility lint
+# (tools/wirelint.py — undeclared/misguarded key emits, unguarded
+# optional reads, schema-evolution drift against the frozen
+# worker/wire_schema.json golden, reply-epoch discipline, value
+# portability; docs/DESIGN.md "Wire discipline"), cross-checks every
+# emit and parse site in worker/ + serve/ against the versioned
+# message registry (worker/wireregistry.py).
+# tests/test_cachelint.py pins the seven legs under a combined
 # one-minute wall-clock budget so the gate stays cheap enough to run.
-lint: shapelint cachelint planlint statelint
+lint: shapelint cachelint planlint statelint wirelint
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check cyclonus_tpu tools bench.py; \
 	else echo "ruff not installed; skipping"; fi
@@ -64,6 +71,9 @@ planlint:
 
 statelint:
 	python tools/statelint.py cyclonus_tpu/serve cyclonus_tpu/audit
+
+wirelint:
+	python tools/wirelint.py cyclonus_tpu/worker cyclonus_tpu/serve
 
 # git-diff-scoped lint: run only the legs whose scanned paths contain a
 # file changed vs the merge base (falls back to HEAD for a clean tree).
@@ -104,6 +114,19 @@ planharness:
 # this is the full sweep (adds the scaled parity leg).
 stateharness:
 	JAX_PLATFORMS=cpu python -m tests.stateharness --full --verbose
+
+# the peer version-skew harness (tests/skewharness.py; docs/DESIGN.md
+# "Wire discipline"): arm the skew-view recorder (CYCLONUS_SKEWHARNESS=1),
+# synthesize older-peer legacy views and newer-peer unknown-key payloads
+# for EVERY registered wire message straight from the registry, push
+# them through the real codecs and the real in-process serve loop, and
+# assert verdict/apply parity against an un-skewed twin — plus the
+# coverage census (no registered optional key unexercised in either
+# skew direction) and the static-vs-runtime manifest byte-identity.
+# The quick slice runs in tier-1 via tests/test_wirelint.py; this is
+# the full sweep (adds the scaled mixed-version stream leg).
+skewharness:
+	JAX_PLATFORMS=cpu python -m tests.skewharness --full --verbose
 
 # the perf observatory's regression sentinel (docs/DESIGN.md "Perf
 # observatory"): ingest the round BENCH_r*/MULTICHIP_r* artifacts and
@@ -242,4 +265,4 @@ cyclonus:
 docker:
 	docker build -t cyclonus-tpu:latest .
 
-.PHONY: test check conformance fuzz fuzz-full race bench chaos slo audit fmt vet lint lint-changed shapelint cachelint planlint statelint keyharness planharness stateharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker
+.PHONY: test check conformance fuzz fuzz-full race bench chaos slo audit fmt vet lint lint-changed shapelint cachelint planlint statelint wirelint keyharness planharness stateharness skewharness perf-gate parity-compressed parity-cidr serve-smoke multichip-smoke cyclonus docker

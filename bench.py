@@ -1138,6 +1138,29 @@ def _audit_detail(serve_detail):
     return None
 
 
+def _wire_detail():
+    """The top-level detail.wire block (perfobs reads it on every
+    line): the wire-protocol generation this run spoke plus a live
+    skew sweep — every registered message round-tripped through its
+    real codec under both skew directions (older-peer legacy views,
+    newer-peer unknown-key injection; worker/wireregistry.py).  The
+    sweep is pure host-side dict shuffling (milliseconds), so it rides
+    every bench line; a non-empty problems list is a bench failure —
+    it means the committed protocol cannot survive a mixed-version
+    fleet.  (This must NOT import tests.skewharness: the harness
+    module arms env flags at import time.)"""
+    from cyclonus_tpu.worker import model, wireregistry
+
+    sweep = wireregistry.skew_sweep(model.CODECS)
+    problems = sweep["problems"]
+    assert not problems, f"wire skew sweep failed: {problems[:5]}"
+    return {
+        "schema_version": sweep["schema_version"],
+        "keys": sweep["keys"],
+        "skew_pairs_checked": sweep["skew_pairs_checked"],
+    }
+
+
 def _chaos_leg():
     """BENCH chaos leg (detail.chaos): SIGKILL a `cyclonus-tpu serve`
     replica mid-churn, restart it against the same persistent caches,
@@ -2216,6 +2239,10 @@ def _bench(done):
                         # (perfobs reads detail.audit on every line;
                         # nonzero diverged is a sentinel warn-note)
                         "audit": _audit_detail(serve_detail),
+                        # the wire-protocol generation + live skew
+                        # sweep (perfobs reads detail.wire on every
+                        # line; the sentinel warn-notes a schema bump)
+                        "wire": _wire_detail(),
                         "chaos": chaos_detail,
                         # the precedence-tier leg (BENCH_TIERS=0 skips,
                         # still recording {active: False}): ANP/BANP
@@ -2335,6 +2362,7 @@ def _bench(done):
                     "mesh": mesh_detail,
                     "serve": serve_detail,
                     "audit": _audit_detail(serve_detail),
+                    "wire": _wire_detail(),
                     "chaos": chaos_detail,
                     "tiers": tiers_detail,
                     "telemetry": tel_snapshot,

@@ -202,6 +202,14 @@ def _bench_run_from_parsed(
             run.audit_diverged = int(audit["diverged"])
         if isinstance(audit.get("digest_s"), (int, float)):
             run.audit_digest_s = float(audit["digest_s"])
+    wire = detail.get("wire")
+    if isinstance(wire, dict):
+        if isinstance(wire.get("schema_version"), int):
+            run.wire_schema_version = int(wire["schema_version"])
+        if isinstance(wire.get("keys"), int):
+            run.wire_keys = int(wire["keys"])
+        if isinstance(wire.get("skew_pairs_checked"), int):
+            run.wire_skew_pairs = int(wire["skew_pairs_checked"])
     tiers = detail.get("tiers")
     if isinstance(tiers, dict):
         run.tiers_active = bool(tiers.get("active"))

@@ -152,6 +152,14 @@ class PerfRun:
     audit_checked: Optional[int] = None
     audit_diverged: Optional[int] = None
     audit_digest_s: Optional[float] = None
+    # detail.wire — the wire-protocol generation the run spoke and the
+    # bench's live skew-sweep census (None: an older artifact).  Warn-
+    # only in the sentinel: a schema_version bump across rounds is a
+    # deliberate protocol change worth a human note, never a perf fail
+    # (wirelint's WR003 golden gate is the hard check).
+    wire_schema_version: Optional[int] = None
+    wire_keys: Optional[int] = None
+    wire_skew_pairs: Optional[int] = None
     error: Optional[str] = None
     metric: Optional[str] = None
 
@@ -205,6 +213,9 @@ class PerfRun:
             "audit_checked": self.audit_checked,
             "audit_diverged": self.audit_diverged,
             "audit_digest_s": self.audit_digest_s,
+            "wire_schema_version": self.wire_schema_version,
+            "wire_keys": self.wire_keys,
+            "wire_skew_pairs": self.wire_skew_pairs,
             "error": self.error,
             "metric": self.metric,
         }

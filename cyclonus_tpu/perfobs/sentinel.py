@@ -449,6 +449,32 @@ def gate(
             "verdicts"
         )
 
+    # --- wire protocol generation: WARN, never fail ---------------------
+    # a schema_version change between the candidate and its baselines is
+    # a deliberate, golden-regenerating protocol change (wirelint WR003
+    # and `make skewharness` are the hard gates) — but perf numbers
+    # straddling a protocol bump deserve a human note, since the serve
+    # leg's reply shape (and so its byte volume) changed with it
+    wire_base = [
+        r.wire_schema_version
+        for r in baselines
+        if isinstance(r.wire_schema_version, int)
+    ]
+    if (
+        isinstance(candidate.wire_schema_version, int)
+        and wire_base
+        and candidate.wire_schema_version != max(wire_base)
+    ):
+        notes.append(
+            "NOTE: wire protocol generation changed "
+            f"(schema_version {max(wire_base)} -> "
+            f"{candidate.wire_schema_version}, "
+            f"{candidate.wire_keys or 0} registered keys, "
+            f"{candidate.wire_skew_pairs or 0} skew pairs swept) — "
+            "baselines predate the protocol change; reported only "
+            "(warn, not fail)"
+        )
+
     # --- precedence-tier leg: WARN, never fail --------------------------
     # same discipline as serve: the leg's oracle spot-parity assertion
     # already fails the bench on correctness, and BENCH_TIERS_* knobs

@@ -44,7 +44,7 @@ def run_stdio(
         try:
             reply = handle_line(service, line)
         except Exception as e:  # a bad line must answer, not kill the loop
-            reply = {"Error": f"{type(e).__name__}: {e}"}
+            reply = {"Error": f"{type(e).__name__}: {e}"}  # wire-emit: Reply
         out_stream.write(json.dumps(reply) + "\n")
         out_stream.flush()
         if max_lines is not None and handled >= max_lines:
@@ -53,8 +53,8 @@ def run_stdio(
 
 
 def handle_line(service: VerdictService, line: str) -> dict:
-    batch = Batch.from_json(line)
-    reply: dict = {}
+    batch = Batch.from_json(line)  # wire-read: Batch
+    reply: dict = {}  # wire-emit: Reply
     if batch.deltas:
         try:
             report = service.apply(batch.deltas)

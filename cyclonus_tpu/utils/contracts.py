@@ -333,3 +333,23 @@ def check_wire(
                 f"{type(v).__name__} ({v!r})"
             )
     _count(1)
+
+
+def check_wire_read(
+    name: str,
+    d: Any,
+    contract: Dict[str, WireField],
+) -> None:
+    """The reader-side twin of check_wire: validate a payload that came
+    OFF the wire from a peer.  Shape first (a malformed line must be
+    rejected with the payload named, not surface as a downstream
+    KeyError/TypeError), then present-key dtype drift — absent optional
+    keys and unknown keys are both legal (old peer / new peer), so this
+    is exactly check_wire's partial mode on top of the object check.
+    Call sites gate on `contracts.CHECK` (CYCLONUS_SHAPE_CHECK=1)."""
+    if not isinstance(d, dict):
+        raise ContractViolation(
+            f"{name}: wire payload must be an object, got "
+            f"{type(d).__name__} ({d!r})"
+        )
+    check_wire(name, d, contract, partial=True)

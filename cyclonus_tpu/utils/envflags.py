@@ -220,6 +220,8 @@ _FLAGS = [
     Flag("CYCLONUS_STATEHARNESS", "bool", False, "harness",
          "Arm the state-surface registry call recorder "
          "(serve/stateregistry.py)."),
+    Flag("CYCLONUS_SKEWHARNESS", "bool", False, "harness",
+         "Arm the wire skew-view recorder (worker/wireregistry.py)."),
 ]
 
 REGISTRY: Dict[str, Flag] = {f.name: f for f in _FLAGS}

@@ -22,6 +22,7 @@ from typing import List
 
 from .. import chaos
 from ..kube.ikubernetes import IKubernetes, KubeError
+from ..utils import contracts
 from ..telemetry import instruments as ti
 from ..utils.bounded import run_bounded
 from ..utils.retry import full_jitter_pause
@@ -76,6 +77,17 @@ class Client:
             parsed = json.loads(stdout) if stdout.strip() else []
         except json.JSONDecodeError as e:
             raise KubeError(f"unable to parse worker output: {e}")
+        # reader-side wire validation (CYCLONUS_SHAPE_CHECK=1): a
+        # malformed peer reply is rejected here with the offending key
+        # named, instead of surfacing as a KeyError deep in from_dict
+        if contracts.CHECK:
+            if not isinstance(parsed, list):
+                raise contracts.ContractViolation(
+                    "worker reply: expected a JSON array of Result "
+                    f"objects, got {type(parsed).__name__}"
+                )
+            for d in parsed:  # wire-read: Result
+                contracts.check_wire_read("Result", d, Result.WIRE)
         return [Result.from_dict(d) for d in parsed]
 
     def batch(self, batch: Batch) -> List[Result]:

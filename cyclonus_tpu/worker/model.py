@@ -1,7 +1,10 @@
 """Batch/Request/Result wire model (reference: worker/model.go).
 
-Wire-protocol compatibility rules — THE one place they are stated (both
-directions are asserted by tests/test_worker.py):
+Wire-protocol compatibility rules (the full versioned declaration —
+key types, optionality, version rows, emit guards — lives in
+worker/wireregistry.py; tools/wirelint.py verifies this module against
+it statically, and tests/skewharness.py replays every version-skew
+pair dynamically):
 
   * The reference shape (Namespace/Pod/Container/Requests; Request/
     Output/Error) is frozen: those keys are always emitted, so an old
@@ -13,22 +16,16 @@ directions are asserted by tests/test_worker.py):
   * Unknown keys are TOLERATED on parse: `from_dict`/`from_json` read
     the keys they know and ignore the rest, so a NEWER peer's extra
     fields never break an older one.
-  * Extensions so far: Result.LatencyMs (per-probe wall-clock, feeds the
-    driver's cyclonus_tpu_probe_latency_seconds histogram),
-    Batch.TraceId + Batch.ParentSpan (driver->worker trace context:
-    the worker records its spans under the driver's trace id, nested
-    under the driver's span path), Result.TraceEvents (the worker's
-    recorded events riding back to the driver for the merged timeline —
-    telemetry/events.py), and the verdict-service messages
-    Batch.Deltas + Batch.Queries (cyclonus_tpu/serve): a driver streams
-    Delta / FlowQuery payloads to a `cyclonus-tpu serve` process on the
-    SAME envelope, and the service answers with Verdict dicts.  An old
-    worker receiving a serve batch simply ignores the unknown keys and
-    probes the (empty) Requests list; an old driver never emits them.
-    Verdict.Shed (cyclonus_tpu/slo) marks a load-shed refusal: emitted
-    only when True, always alongside Error, so a pre-SLO consumer sees
-    an ordinary error-verdict and never misreads the all-False allow
-    bits as a deny.
+  * Evolution is additive-optional ONLY: which version introduced each
+    key is pinned by worker/wire_schema.json (the committed golden);
+    changing the protocol = adding a registry row and regenerating the
+    golden (`python -m cyclonus_tpu.worker.wireregistry
+    --write-golden`), never editing a shipped key.
+
+Each class's ``WIRE`` table is DERIVED from the registry
+(`wireregistry.wire_table`), so a key declared there is covered by
+emit-check, reader-check, the skew views, and the frozen schema
+automatically.
 """
 
 from __future__ import annotations
@@ -38,22 +35,20 @@ from dataclasses import dataclass, field
 from typing import Any, ClassVar, Dict, List, Optional
 
 from ..utils import contracts
+from . import wireregistry
 
 
 @dataclass
 class Request:
     """model.go:26-48."""
 
-    # Wire dtype contract (tools/shapelint.py checks the emit side
+    # Wire dtype contract, derived from the one declaration in
+    # wireregistry.MESSAGES (tools/wirelint.py checks emit/read sites
     # statically; contracts.check_wire validates real payloads under
-    # CYCLONUS_SHAPE_CHECK=1).  Required keys are the frozen reference
-    # shape; `optional=True` marks extensions (module docstring rules).
-    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
-        "Key": contracts.wire(str),
-        "Protocol": contracts.wire(str),
-        "Host": contracts.wire(str),
-        "Port": contracts.wire(int),
-    }
+    # CYCLONUS_SHAPE_CHECK=1).
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = (
+        wireregistry.wire_table("Request")
+    )
 
     key: str
     protocol: str
@@ -123,14 +118,9 @@ class Delta:
         "banp_delete",   #
     )
 
-    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
-        "Kind": contracts.wire(str),
-        "Namespace": contracts.wire(str),
-        "Name": contracts.wire(str, optional=True),
-        "Labels": contracts.wire(dict, optional=True),
-        "Ip": contracts.wire(str, optional=True),
-        "Policy": contracts.wire(dict, optional=True),
-    }
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = (
+        wireregistry.wire_table("Delta")
+    )
 
     kind: str
     namespace: str = ""  # empty for the cluster-scoped tier kinds
@@ -156,7 +146,7 @@ class Delta:
     @staticmethod
     def from_dict(d: dict) -> "Delta":
         if contracts.CHECK:
-            contracts.check_wire("Delta", d, Delta.WIRE, partial=True)
+            contracts.check_wire_read("Delta", d, Delta.WIRE)
         labels = d.get("Labels")
         policy = d.get("Policy")
         return Delta(
@@ -176,13 +166,9 @@ class FlowQuery:
     the (port, port_name, protocol) triple resolves exactly like an
     engine PortCase."""
 
-    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
-        "Src": contracts.wire(str),
-        "Dst": contracts.wire(str),
-        "Port": contracts.wire(int),
-        "Protocol": contracts.wire(str),
-        "PortName": contracts.wire(str, optional=True),
-    }
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = (
+        wireregistry.wire_table("FlowQuery")
+    )
 
     src: str
     dst: str
@@ -206,7 +192,7 @@ class FlowQuery:
     @staticmethod
     def from_dict(d: dict) -> "FlowQuery":
         if contracts.CHECK:
-            contracts.check_wire("FlowQuery", d, FlowQuery.WIRE, partial=True)
+            contracts.check_wire_read("FlowQuery", d, FlowQuery.WIRE)
         return FlowQuery(
             src=d.get("Src", ""),
             dst=d.get("Dst", ""),
@@ -231,16 +217,9 @@ class Verdict:
     field still treats it as a non-answer rather than reading the
     all-False bits as a deny."""
 
-    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
-        "Query": contracts.wire(dict),
-        "Ingress": contracts.wire(bool),
-        "Egress": contracts.wire(bool),
-        "Combined": contracts.wire(bool),
-        "Epoch": contracts.wire(int, optional=True),
-        "Error": contracts.wire(str, optional=True),
-        "LatencyMs": contracts.wire(float, optional=True),
-        "Shed": contracts.wire(bool, optional=True),
-    }
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = (
+        wireregistry.wire_table("Verdict")
+    )
 
     query: FlowQuery
     ingress: bool = False
@@ -273,7 +252,7 @@ class Verdict:
     @staticmethod
     def from_dict(d: dict) -> "Verdict":
         if contracts.CHECK:
-            contracts.check_wire("Verdict", d, Verdict.WIRE, partial=True)
+            contracts.check_wire_read("Verdict", d, Verdict.WIRE)
         latency = d.get("LatencyMs")
         return Verdict(
             query=FlowQuery.from_dict(d.get("Query") or {}),
@@ -302,16 +281,9 @@ class Batch:
     one stream can carry probes to workers and deltas/queries to the
     service without a second protocol."""
 
-    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
-        "Namespace": contracts.wire(str),
-        "Pod": contracts.wire(str),
-        "Container": contracts.wire(str),
-        "Requests": contracts.wire(list),
-        "TraceId": contracts.wire(str, optional=True),
-        "ParentSpan": contracts.wire(str, optional=True),
-        "Deltas": contracts.wire(list, optional=True),
-        "Queries": contracts.wire(list, optional=True),
-    }
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = (
+        wireregistry.wire_table("Batch")
+    )
 
     namespace: str
     pod: str
@@ -348,8 +320,11 @@ class Batch:
     def from_json(text: str) -> "Batch":
         d = json.loads(text)
         # tolerant parse on purpose (module docstring): missing required
-        # keys default rather than raise, so no check_wire here — an old
-        # peer's payload must keep parsing
+        # keys default rather than raise — but a payload that isn't an
+        # object, or a present key with a drifted type, is a peer wire
+        # break and gets rejected with the offending key named
+        if contracts.CHECK:
+            contracts.check_wire_read("Batch", d, Batch.WIRE)
         return Batch(
             namespace=d.get("Namespace", ""),
             pod=d.get("Pod", ""),
@@ -372,13 +347,9 @@ class Result:
     histogram, and the worker's recorded trace events riding back for
     the merged driver+worker timeline."""
 
-    WIRE: ClassVar[Dict[str, contracts.WireField]] = {
-        "Request": contracts.wire(dict),
-        "Output": contracts.wire(str),
-        "Error": contracts.wire(str),
-        "LatencyMs": contracts.wire(float, optional=True),
-        "TraceEvents": contracts.wire(list, optional=True),
-    }
+    WIRE: ClassVar[Dict[str, contracts.WireField]] = (
+        wireregistry.wire_table("Result")
+    )
 
     request: Request
     output: str = ""
@@ -408,7 +379,7 @@ class Result:
         # parse side is tolerant of ABSENT keys (old peers), but a
         # present key with a drifted type is a wire break worth catching
         if contracts.CHECK:
-            contracts.check_wire("Result", d, Result.WIRE, partial=True)
+            contracts.check_wire_read("Result", d, Result.WIRE)
         latency = d.get("LatencyMs")
         events = d.get("TraceEvents")
         return Result(
@@ -418,3 +389,21 @@ class Result:
             latency_ms=float(latency) if latency is not None else None,
             trace_events=list(events) if events else None,
         )
+
+
+#: The real (parse, emit) pair for each registered message this module
+#: models — what wireregistry.skew_sweep drives every synthesized skew
+#: view through, so the compat proof exercises THESE codecs, not a
+#: test-only re-implementation.  (The Reply envelope has no class; the
+#: sweep falls back to the registry-generic codec for it.)
+CODECS: Dict[str, Any] = {
+    "Request": (Request.from_dict, lambda r: r.to_dict()),
+    "Batch": (
+        lambda d: Batch.from_json(json.dumps(d)),
+        lambda b: json.loads(b.to_json()),
+    ),
+    "Result": (Result.from_dict, lambda r: r.to_dict()),
+    "Delta": (Delta.from_dict, lambda x: x.to_dict()),
+    "FlowQuery": (FlowQuery.from_dict, lambda q: q.to_dict()),
+    "Verdict": (Verdict.from_dict, lambda v: v.to_dict()),
+}

@@ -258,6 +258,14 @@ class TestBenchGuards:
         chaos_detail = detail["chaos"]
         assert chaos_detail["ttfv_s"] is None
         assert "make chaos" in chaos_detail["skipped"]
+        # detail.wire rides EVERY line: the wire-protocol generation
+        # plus the live registry skew sweep (worker/wireregistry.py) —
+        # both skew directions for every registered message through the
+        # real codecs, asserted clean inside the bench
+        wire = detail["wire"]
+        assert wire["schema_version"] >= 5
+        assert wire["keys"] >= 30
+        assert wire["skew_pairs_checked"] >= 10
         assert "eval_reps" in detail and len(detail["eval_reps"]) == 5
         # roofline only reports for the pallas backend
         assert detail["roofline"] is None

@@ -854,12 +854,12 @@ class TestKeyharnessFull:
 
 
 class TestLintBudget:
-    def test_six_legs_stay_under_wall_clock_budget(self):
+    def test_seven_legs_stay_under_wall_clock_budget(self):
         """The combined `make lint` static legs (jaxlint + locklint +
-        shapelint + cachelint + planlint + statelint, in-process over
-        their Makefile paths) must stay inside one minute — the six-leg
-        lint is part of `make check`'s inner loop and a slow linter
-        stops being run."""
+        shapelint + cachelint + planlint + statelint + wirelint,
+        in-process over their Makefile paths) must stay inside one
+        minute — the seven-leg lint is part of `make check`'s inner
+        loop and a slow linter stops being run."""
         import importlib
 
         t0 = time.perf_counter()
@@ -868,6 +868,7 @@ class TestLintBudget:
         shapelint = importlib.import_module("shapelint")
         planlint = importlib.import_module("planlint")
         statelint = importlib.import_module("statelint")
+        wirelint = importlib.import_module("wirelint")
         jax_paths = [
             os.path.join(REPO, "cyclonus_tpu", p)
             for p in (
@@ -900,5 +901,11 @@ class TestLintBudget:
                 for p in ("serve", "audit")
             ]
         )
+        wirelint.lint_paths(
+            [
+                os.path.join(REPO, "cyclonus_tpu", p)
+                for p in ("worker", "serve")
+            ]
+        )
         elapsed = time.perf_counter() - t0
-        assert elapsed < 60.0, f"six lint legs took {elapsed:.1f}s"
+        assert elapsed < 60.0, f"seven lint legs took {elapsed:.1f}s"

@@ -534,19 +534,33 @@ class TestWireDrift:
     def test_worker_model_optional_field_drift_is_caught(self, tmp_path):
         """The compat gate the wire suite relies on: emitting
         Result.LatencyMs unconditionally (an optional-field contract
-        change) must be flagged when worker/model.py drifts."""
-        src = open(os.path.join(REPO, "cyclonus_tpu", "worker", "model.py")).read()
+        change) must be flagged when worker/model.py drifts.  The WIRE
+        tables are registry projections now (worker/wireregistry.py),
+        not literals shapelint can extract — so the gate on the REAL
+        model moved to wirelint's WR001; this test pins it against a
+        drifted copy of the real tree (model + registry + golden)."""
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.join(REPO, "tools"))
+        import wirelint
+
+        worker = os.path.join(REPO, "cyclonus_tpu", "worker")
+        src = open(os.path.join(worker, "model.py")).read()
         drifted = src.replace(
             "        if self.latency_ms is not None:\n"
             "            d[\"LatencyMs\"] = self.latency_ms\n",
             "        d[\"LatencyMs\"] = self.latency_ms\n",
         )
         assert drifted != src, "model.py emit site moved; update this test"
-        p = tmp_path / "model_drifted.py"
-        p.write_text(drifted)
-        findings, _ = shapelint.lint_paths([str(p)])
+        pkg = tmp_path / "worker_drifted"
+        pkg.mkdir()
+        (pkg / "model.py").write_text(drifted)
+        for name in ("wireregistry.py", "wire_schema.json"):
+            (pkg / name).write_text(open(os.path.join(worker, name)).read())
+        findings, _ = wirelint.lint_paths([str(pkg)])
         assert any(
-            f.code == "SC001" and "LatencyMs" in f.message for f in findings
+            f.code == "WR001" and "LatencyMs" in f.message
+            and "unconditionally" in f.message for f in findings
         ), findings
 
 

@@ -114,12 +114,18 @@ class TestWireCompatibility:
         assert r2.trace_events == r.trace_events
 
     def test_wire_contract_golden(self):
-        """The dtype-contract half of the compat rules (utils/contracts
-        + tools/shapelint.py): the WIRE declarations ARE the protocol.
-        This golden pins every key's (type, optional) pair — changing a
-        contract on an optional field (or demoting a required one)
-        fails here before it can ship a silent wire break.  Update the
-        golden AND the module docstring together, never one alone."""
+        """The dtype-contract half of the compat rules: the versioned
+        registry (worker/wireregistry.py) IS the protocol, and its
+        committed projection worker/wire_schema.json is the frozen
+        golden.  This census pins every live WIRE table to the frozen
+        schema's (type, optional) rows — changing a contract without
+        regenerating the golden (`python -m cyclonus_tpu.worker.
+        wireregistry --write-golden`, the explicit diffable protocol
+        change) fails here AND in wirelint's WR003 before it can ship a
+        silent wire break."""
+        import json as _json
+
+        from cyclonus_tpu.worker import wireregistry
         from cyclonus_tpu.worker.model import (
             Batch,
             Delta,
@@ -129,59 +135,23 @@ class TestWireCompatibility:
             Verdict,
         )
 
-        golden = {
-            Request: {
-                "Key": (str, False),
-                "Protocol": (str, False),
-                "Host": (str, False),
-                "Port": (int, False),
-            },
-            Batch: {
-                "Namespace": (str, False),
-                "Pod": (str, False),
-                "Container": (str, False),
-                "Requests": (list, False),
-                "TraceId": (str, True),
-                "ParentSpan": (str, True),
-                "Deltas": (list, True),
-                "Queries": (list, True),
-            },
-            Result: {
-                "Request": (dict, False),
-                "Output": (str, False),
-                "Error": (str, False),
-                "LatencyMs": (float, True),
-                "TraceEvents": (list, True),
-            },
-            Delta: {
-                "Kind": (str, False),
-                "Namespace": (str, False),
-                "Name": (str, True),
-                "Labels": (dict, True),
-                "Ip": (str, True),
-                "Policy": (dict, True),
-            },
-            FlowQuery: {
-                "Src": (str, False),
-                "Dst": (str, False),
-                "Port": (int, False),
-                "Protocol": (str, False),
-                "PortName": (str, True),
-            },
-            Verdict: {
-                "Query": (dict, False),
-                "Ingress": (bool, False),
-                "Egress": (bool, False),
-                "Combined": (bool, False),
-                "Epoch": (int, True),
-                "Error": (str, True),
-                "LatencyMs": (float, True),
-                "Shed": (bool, True),
-            },
-        }
-        for cls, want in golden.items():
-            got = {k: (wf.type, wf.optional) for k, wf in cls.WIRE.items()}
-            assert got == want, f"{cls.__name__} wire contract drifted"
+        with open(wireregistry.golden_path()) as f:
+            frozen = _json.load(f)
+        assert frozen["schema_version"] == wireregistry.PROTOCOL_VERSION
+        for cls in (Request, Batch, Result, Delta, FlowQuery, Verdict):
+            rows = frozen["messages"][cls.__name__]["keys"]
+            got = {
+                k: (wf.type.__name__, wf.optional)
+                for k, wf in cls.WIRE.items()
+            }
+            want = {k: (r["type"], r["optional"]) for k, r in rows.items()}
+            assert got == want, (
+                f"{cls.__name__} wire contract drifted from "
+                "wire_schema.json"
+            )
+        # every registered message is frozen, Reply included (it has no
+        # model class — the serve loop emits it as a plain dict)
+        assert set(frozen["messages"]) == set(wireregistry.message_names())
 
     def test_serve_messages_roundtrip(self):
         """The verdict-service payloads (Deltas/Queries) ride the Batch
@@ -256,15 +226,19 @@ class TestWireCompatibility:
         zero results instead of crashing."""
         import json as _json
 
+        from cyclonus_tpu.worker import wireregistry
         from cyclonus_tpu.worker.model import Delta
 
         b = make_batch(0)
         b.deltas = [Delta(kind="pod_remove", namespace="x", name="p")]
         raw = _json.loads(b.to_json())
-        # what an OLD peer sees: it reads only the keys it knows
-        legacy_view = {
-            k: raw[k] for k in ("Namespace", "Pod", "Container", "Requests")
-        }
+        # what an OLD peer sees, synthesized by the registry itself: a
+        # v3 Batch reader predates Deltas/Queries (since=4) and drops
+        # them, keeping the frozen required shape
+        legacy_view = wireregistry.legacy_view("Batch", raw, 3)
+        assert "Deltas" not in legacy_view
+        assert set(legacy_view) >= {"Namespace", "Pod", "Container",
+                                    "Requests"}
         out = run_worker(_json.dumps(legacy_view))
         assert _json.loads(out) == []
         # and the NEW parser round-trips the legacy view without deltas
@@ -293,21 +267,26 @@ class TestWireCompatibility:
             contracts.check_wire("Delta", {"Namespace": "x"}, Delta.WIRE)
 
     def test_wire_contract_statically_linted(self):
-        """shapelint's emit-side check runs over worker/model.py in
-        `make lint`; assert it stays clean here too so a local edit
-        can't land between lint runs."""
+        """wirelint's emit/read-side checks run over worker/ + serve/
+        in `make lint`; assert the wire surfaces stay clean here too so
+        a local edit can't land between lint runs.  (shapelint no
+        longer extracts the WIRE tables — they are registry projections
+        now, not literals — so the wire-protocol lint leg is wirelint.)
+        """
         import os
         import sys as _sys
 
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         _sys.path.insert(0, os.path.join(repo, "tools"))
-        import shapelint
+        import wirelint
 
-        findings, stats = shapelint.lint_paths(
-            [os.path.join(repo, "cyclonus_tpu", "worker", "model.py")]
+        findings, stats = wirelint.lint_paths(
+            [os.path.join(repo, "cyclonus_tpu", p)
+             for p in ("worker", "serve")]
         )
         assert findings == [], "\n".join(f.render() for f in findings)
-        assert stats["contracts"] >= 15, stats  # 3 WIRE maps
+        assert stats["messages"] >= 7, stats
+        assert stats["keys"] >= 30, stats
 
     def test_registry_delta_kinds_all_on_the_wire(self):
         """Every delta Kind the state registry declares (and that

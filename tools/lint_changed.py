@@ -73,6 +73,14 @@ LEGS: Tuple[Tuple[str, List[str], List[str]], ...] = (
         ["cyclonus_tpu/serve", "cyclonus_tpu/audit",
          "cyclonus_tpu/worker/model.py", "Makefile", "tests/"],
     ),
+    (
+        # registry-level leg: WR003 reads the frozen wire_schema.json
+        # golden, and the harness gate files live under tests/
+        "wirelint",
+        ["cyclonus_tpu/worker", "cyclonus_tpu/serve"],
+        ["cyclonus_tpu/worker", "cyclonus_tpu/serve", "Makefile",
+         "tests/"],
+    ),
 )
 
 

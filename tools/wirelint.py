@@ -1,0 +1,938 @@
+"""wirelint: wire-protocol compatibility verifier.
+
+The seventh linter leg (jaxlint / locklint / shapelint / cachelint /
+planlint / statelint / wirelint — shared scaffolding in
+tools/lintcore.py).  The runtime twin is
+cyclonus_tpu/worker/wireregistry.py: a declarative, VERSIONED registry
+of every wire message (Batch/Request/Result/Delta/FlowQuery/Verdict
+and the serve Reply envelope) recording per key its JSON type,
+optionality, the protocol version that introduced it, its emit guard,
+its float canonicalization, and whether its value is portable across
+peers.  wirelint extracts the registry from the AST (no import — a
+package syntax error cannot take the linter down) and cross-checks the
+scanned worker/ + serve/ modules plus the frozen committed golden
+worker/wire_schema.json:
+
+  WR001  emit site writes an undeclared wire key, or violates the
+         declared emit guard (a required key emitted conditionally, an
+         optional key emitted unconditionally, a `with=K` key emitted
+         outside an emit branch that also writes K).
+  WR002  optional-key read without a default or presence guard: an old
+         peer's payload (key absent) would KeyError a new reader.
+  WR003  schema evolution violation against the frozen golden —
+         removed key, re-typed key, optional<->required flip, version
+         pin drift, or a new key/version without a row.  Additive-
+         optional is the ONLY legal change; regenerating the golden
+         (`python -m cyclonus_tpu.worker.wireregistry --write-golden`)
+         is the explicit, diffable act of changing the protocol.
+  WR004  reply-epoch discipline: a reply carrying verdicts must stamp
+         exactly one Epoch, taken from the verdicts' own batch (an
+         `.epoch` / `["epoch"]` read, never an unrelated constant);
+         an epoch="stamp" message must be constructed with an explicit
+         epoch= at every call site — the replica-read invariant
+         ROADMAP item 1 stands on.
+  WR005  non-portable value on the wire: a float key with no declared
+         canonicalization, or a pid/timestamp/identity value written
+         into a key declared comparable across peers.
+
+Emit/read sites wirelint cannot attribute to a model class carry
+trailing markers: `# wire-emit: <Message>` on the statement creating
+the reply dict, `# wire-read: <Message>` on the parse statement.
+
+Suppress a finding with `# wirelint: ignore[WR00X]` on the offending
+line.
+
+Run: python tools/wirelint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from lintcore import Finding, ignore_regex, iter_py_files, run_cli, suppress
+
+_IGNORE_RE = ignore_regex("wirelint")
+
+DEFAULT_PATHS = [
+    "cyclonus_tpu/worker",
+    "cyclonus_tpu/serve",
+]
+
+REGISTRY_BASENAME = "wireregistry.py"
+GOLDEN_BASENAME = "wire_schema.json"
+
+_EMIT_MARK_RE = re.compile(r"#\s*wire-emit:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_READ_MARK_RE = re.compile(r"#\s*wire-read:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: call leaves whose value is process-local by construction (WR005)
+_NONPORTABLE_CALLS = {
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "getpid", "id", "hash", "uuid1", "uuid4",
+}
+
+
+# --------------------------------------------------------------------------
+# Registry extraction (statelint's discipline: literal Message(...) /
+# Key(...) calls read off the AST, never imported).
+# --------------------------------------------------------------------------
+
+@dataclass
+class KeyDecl:
+    name: str
+    type: str
+    optional: bool
+    since: int
+    guard: str
+    canon: str
+    portable: bool
+    ref: str
+    sample: object
+    note: str
+    line: int
+
+    def effective_guard(self) -> str:
+        return self.guard or ("set" if self.optional else "always")
+
+    def guard_tokens(self) -> List[str]:
+        return [t.strip() for t in self.effective_guard().split(",") if t]
+
+
+@dataclass
+class MessageDecl:
+    name: str
+    since: int
+    epoch: str
+    keys: List[KeyDecl] = field(default_factory=list)
+    note: str = ""
+    line: int = 0
+
+    def key_by_name(self, name: str) -> Optional[KeyDecl]:
+        for k in self.keys:
+            if k.name == name:
+                return k
+        return None
+
+
+@dataclass
+class Registry:
+    path: str = ""
+    protocol_version: int = 0
+    versions: Dict[int, str] = field(default_factory=dict)
+    messages: List[MessageDecl] = field(default_factory=list)
+
+    def message(self, name: str) -> Optional[MessageDecl]:
+        for m in self.messages:
+            if m.name == name:
+                return m
+        return None
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    return fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+
+
+def _extract_key(call: ast.Call) -> KeyDecl:
+    kw: Dict[str, object] = {}
+    positional = ["name", "type"]
+    for i, a in enumerate(call.args):
+        if i < len(positional):
+            kw[positional[i]] = _literal(a)
+    for k in call.keywords:
+        if k.arg:
+            kw[k.arg] = _literal(k.value)
+    return KeyDecl(
+        name=str(kw.get("name") or ""),
+        type=str(kw.get("type") or ""),
+        optional=bool(kw.get("optional", False)),
+        since=int(kw.get("since") or 1),
+        guard=str(kw.get("guard") or ""),
+        canon=str(kw.get("canon") or ""),
+        portable=bool(kw.get("portable", True)),
+        ref=str(kw.get("ref") or ""),
+        sample=kw.get("sample"),
+        note=str(kw.get("note") or ""),
+        line=call.lineno,
+    )
+
+
+def _extract_message(call: ast.Call) -> MessageDecl:
+    kw: Dict[str, object] = {}
+    keys_node: Optional[ast.AST] = None
+    for i, a in enumerate(call.args):
+        if i == 0:
+            kw["name"] = _literal(a)
+    for k in call.keywords:
+        if k.arg == "keys":
+            keys_node = k.value
+        elif k.arg:
+            kw[k.arg] = _literal(k.value)
+    keys: List[KeyDecl] = []
+    if isinstance(keys_node, ast.Tuple):
+        for el in keys_node.elts:
+            if isinstance(el, ast.Call) and _call_name(el) == "Key":
+                keys.append(_extract_key(el))
+    return MessageDecl(
+        name=str(kw.get("name") or ""),
+        since=int(kw.get("since") or 1),
+        epoch=str(kw.get("epoch") or ""),
+        keys=keys,
+        note=str(kw.get("note") or ""),
+        line=call.lineno,
+    )
+
+
+def load_registry(registry_path: str) -> Optional[Registry]:
+    try:
+        with open(registry_path, "r") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    reg = Registry(path=registry_path)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tgts = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in tgts:
+                if not isinstance(tgt, ast.Name) or node.value is None:
+                    continue
+                if tgt.id == "PROTOCOL_VERSION":
+                    val = _literal(node.value)
+                    if isinstance(val, int):
+                        reg.protocol_version = val
+                elif tgt.id == "VERSIONS":
+                    val = _literal(node.value)
+                    if isinstance(val, dict):
+                        reg.versions = {
+                            int(k): str(v) for k, v in val.items()
+                        }
+                elif tgt.id == "MESSAGES" and isinstance(
+                    node.value, ast.Tuple
+                ):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Call) and (
+                            _call_name(el) == "Message"
+                        ):
+                            reg.messages.append(_extract_message(el))
+    return reg
+
+
+def find_registry(paths: List[str]) -> Optional[str]:
+    """Locate wireregistry.py: inside a scanned directory, else
+    relative to the repo root the scanned paths live under."""
+    for p in paths:
+        if os.path.isdir(p):
+            cand = os.path.join(p, REGISTRY_BASENAME)
+            if os.path.exists(cand):
+                return cand
+        elif os.path.basename(p) == REGISTRY_BASENAME:
+            return p
+    anchor = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+    for _ in range(6):
+        cand = os.path.join(
+            cur, "cyclonus_tpu", "worker", REGISTRY_BASENAME
+        )
+        if os.path.exists(cand):
+            return cand
+        cur = os.path.dirname(cur)
+    return None
+
+
+def golden_path_for(registry_path: str) -> str:
+    return os.path.join(os.path.dirname(registry_path), GOLDEN_BASENAME)
+
+
+# --------------------------------------------------------------------------
+# Emit/read site collection.
+# --------------------------------------------------------------------------
+
+@dataclass
+class Write:
+    """One `var["Key"] = ...` store (or dict-literal entry) with the If
+    nodes lexically enclosing it."""
+    key: str
+    line: int
+    col: int
+    value: Optional[ast.AST]
+    if_stack: Tuple[ast.AST, ...]
+
+
+def _target_writes(stmt: ast.AST, var: str,
+                   stack: Tuple[ast.AST, ...]) -> List[Write]:
+    out: List[Write] = []
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        tgts = (
+            stmt.targets if isinstance(stmt, ast.Assign)
+            else [stmt.target]
+        )
+        for tgt in tgts:
+            if (
+                isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == var
+            ):
+                key = _const_str(tgt.slice)
+                if key is not None:
+                    out.append(Write(
+                        key, stmt.lineno, stmt.col_offset, stmt.value,
+                        stack,
+                    ))
+            elif (
+                isinstance(tgt, ast.Name)
+                and tgt.id == var
+                and isinstance(stmt.value, ast.Dict)
+            ):
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    key = _const_str(k) if k is not None else None
+                    if key is not None:
+                        out.append(Write(
+                            key, stmt.lineno, stmt.col_offset, v, stack,
+                        ))
+    return out
+
+
+def collect_writes(func: ast.AST, var: str) -> List[Write]:
+    """Every store of a constant string key into `var` within `func`,
+    each with its lexical If context (for emit-guard checks)."""
+    writes: List[Write] = []
+
+    def visit(stmt: ast.AST, stack: Tuple[ast.AST, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(stmt, ast.If):
+            for s in stmt.body:
+                visit(s, stack + (stmt,))
+            for s in stmt.orelse:
+                visit(s, stack + (stmt,))
+            return
+        writes.extend(_target_writes(stmt, var, stack))
+        for fld in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, fld, []) or []:
+                visit(s, stack)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for s in handler.body:
+                visit(s, stack)
+
+    for s in func.body:
+        visit(s, ())
+    return writes
+
+
+def _if_writes_key(if_node: ast.AST, var: str, key: str) -> bool:
+    """Does any statement in this If's subtree store `key` into
+    `var`?  (the `with=K` anchor check: ParentSpan's enclosing
+    `if self.trace_id:` block also writes TraceId.)"""
+    for sub in ast.walk(if_node):
+        for w in _target_writes(sub, var, ()):
+            if w.key == key:
+                return True
+    return False
+
+
+def _emit_var(func: ast.AST) -> Optional[str]:
+    """The result-dict variable of an emit function: the target of the
+    first dict-literal assignment (`d = {...}` / `reply: dict = {}`)."""
+    for sub in ast.walk(func):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            tgts = (
+                sub.targets if isinstance(sub, ast.Assign)
+                else [sub.target]
+            )
+            if sub.value is None or not isinstance(sub.value, ast.Dict):
+                continue
+            for tgt in tgts:
+                if isinstance(tgt, ast.Name):
+                    return tgt.id
+    return None
+
+
+def _value_nonportable_call(value: Optional[ast.AST]) -> Optional[str]:
+    if value is None:
+        return None
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            leaf = _call_name(sub)
+            if leaf in _NONPORTABLE_CALLS:
+                return leaf
+    return None
+
+
+def _epoch_sourced(value: Optional[ast.AST]) -> bool:
+    """Is the written epoch value derived from an epoch accessor
+    (`verdicts[0].epoch`, `report["epoch"]`, `service.epoch`) rather
+    than an unrelated constant/counter?"""
+    if value is None:
+        return False
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Attribute) and sub.attr == "epoch":
+            return True
+        if isinstance(sub, ast.Subscript):
+            s = _const_str(sub.slice)
+            if s is not None and s.lower() == "epoch":
+                return True
+    return False
+
+
+def _epoch_fallback_guarded(w: Write, var: str) -> bool:
+    """Is this Epoch write guarded by `"Epoch" not in <var>` (the
+    exactly-one-stamp fallback pattern)?"""
+    for if_node in w.if_stack:
+        test = getattr(if_node, "test", None)
+        if not isinstance(test, ast.Compare):
+            continue
+        if not any(isinstance(op, ast.NotIn) for op in test.ops):
+            continue
+        if _const_str(test.left) == "Epoch":
+            return True
+    return False
+
+
+def _enclosing_func(tree: ast.Module, line: int) -> Optional[ast.AST]:
+    best: Optional[ast.AST] = None
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end:
+            if best is None or node.lineno > best.lineno:
+                best = node
+    return best
+
+
+# --------------------------------------------------------------------------
+# Per-check logic.
+# --------------------------------------------------------------------------
+
+def _check_class_emit(path: str, msg: MessageDecl, func: ast.AST,
+                      findings: List[Finding]) -> bool:
+    """WR001 + WR005 over one model-class emit function (to_dict /
+    to_json).  Returns True when the function emits (has a result
+    dict)."""
+    var = _emit_var(func)
+    if var is None:
+        return False
+    writes = collect_writes(func, var)
+    for w in writes:
+        decl = msg.key_by_name(w.key)
+        if decl is None:
+            findings.append(Finding(
+                path, w.line, w.col, "WR001",
+                f"{msg.name} emit writes undeclared wire key {w.key!r} "
+                f"(not in the wireregistry declaration)",
+            ))
+            continue
+        tokens = decl.guard_tokens()
+        if "always" in tokens and w.if_stack:
+            findings.append(Finding(
+                path, w.line, w.col, "WR001",
+                f"required wire key {msg.name}.{w.key} emitted "
+                f"conditionally (declared guard 'always': an old reader "
+                f"relies on it)",
+            ))
+        if "set" in tokens and not w.if_stack:
+            findings.append(Finding(
+                path, w.line, w.col, "WR001",
+                f"optional wire key {msg.name}.{w.key} emitted "
+                f"unconditionally (declared guard 'set': emit only when "
+                f"set, so old payloads stay byte-stable)",
+            ))
+        for tok in tokens:
+            if tok.startswith("with="):
+                anchor = tok[len("with="):]
+                if not any(
+                    _if_writes_key(if_node, var, anchor)
+                    for if_node in w.if_stack
+                ):
+                    findings.append(Finding(
+                        path, w.line, w.col, "WR001",
+                        f"wire key {msg.name}.{w.key} declares guard "
+                        f"{tok!r} but its emit branch never writes "
+                        f"{anchor!r}",
+                    ))
+        if decl.portable:
+            leaf = _value_nonportable_call(w.value)
+            if leaf is not None:
+                findings.append(Finding(
+                    path, w.line, w.col, "WR005",
+                    f"wire key {msg.name}.{w.key} is declared portable "
+                    f"but its value calls {leaf}() (process-local: "
+                    f"peers could never compare it)",
+                ))
+    return True
+
+
+def _check_marker_emit(path: str, msg: MessageDecl, func: ast.AST,
+                       var: str, findings: List[Finding]) -> None:
+    """WR001 (undeclared keys) + WR004 (reply-epoch discipline) +
+    WR005 over one marker-annotated emit function.  Guard
+    conditionality is NOT enforced here: a reply builder legally
+    branches (which is why it carries a marker, not a class)."""
+    writes = collect_writes(func, var)
+    for w in writes:
+        decl = msg.key_by_name(w.key)
+        if decl is None:
+            findings.append(Finding(
+                path, w.line, w.col, "WR001",
+                f"{msg.name} emit writes undeclared wire key {w.key!r} "
+                f"(not in the wireregistry declaration)",
+            ))
+            continue
+        if decl.portable:
+            leaf = _value_nonportable_call(w.value)
+            if leaf is not None:
+                findings.append(Finding(
+                    path, w.line, w.col, "WR005",
+                    f"wire key {msg.name}.{w.key} is declared portable "
+                    f"but its value calls {leaf}() (process-local: "
+                    f"peers could never compare it)",
+                ))
+    if msg.epoch != "from-verdicts":
+        return
+    verdict_writes = [w for w in writes if w.key == "Verdicts"]
+    epoch_writes = sorted(
+        (w for w in writes if w.key == "Epoch"), key=lambda w: w.line
+    )
+    if verdict_writes and not epoch_writes:
+        w = verdict_writes[0]
+        findings.append(Finding(
+            path, w.line, w.col, "WR004",
+            f"{msg.name} reply carries Verdicts but never stamps an "
+            f"Epoch (epoch='from-verdicts': every verdict-bearing reply "
+            f"anchors its staleness)",
+        ))
+    if len(epoch_writes) > 1:
+        last = epoch_writes[-1]
+        if not _epoch_fallback_guarded(last, var):
+            findings.append(Finding(
+                path, last.line, last.col, "WR004",
+                f"{msg.name} reply may stamp Epoch more than once: the "
+                f"final write is not guarded by '\"Epoch\" not in "
+                f"{var}' (want exactly one stamp per reply)",
+            ))
+    for w in epoch_writes:
+        if not _epoch_sourced(w.value):
+            findings.append(Finding(
+                path, w.line, w.col, "WR004",
+                f"{msg.name}.Epoch is not taken from an epoch accessor "
+                f"(want the verdicts' own batch epoch: an `.epoch` "
+                f"attribute or ['epoch'] read, never a constant)",
+            ))
+
+
+def _check_parse_reads(path: str, msg: MessageDecl, func: ast.AST,
+                       findings: List[Finding]) -> None:
+    """WR002: an optional key subscripted without a presence guard
+    inside a parse function — an old peer's payload would KeyError."""
+    optional = {k.name for k in msg.keys if k.optional}
+    if not optional:
+        return
+
+    def guarded(stack: Tuple[ast.AST, ...], key: str) -> bool:
+        for if_node in stack:
+            test = getattr(if_node, "test", None)
+            if test is None:
+                continue
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Compare) and any(
+                    isinstance(op, ast.In) for op in sub.ops
+                ):
+                    if _const_str(sub.left) == key:
+                        return True
+        return False
+
+    def visit(stmt: ast.AST, stack: Tuple[ast.AST, ...]) -> None:
+        if isinstance(stmt, ast.If):
+            for s in stmt.body:
+                visit(s, stack + (stmt,))
+            for s in stmt.orelse:
+                visit(s, stack + (stmt,))
+            return
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Subscript):
+                continue
+            if not isinstance(sub.ctx, ast.Load):
+                continue
+            key = _const_str(sub.slice)
+            if key in optional and not guarded(stack, key):
+                findings.append(Finding(
+                    path, sub.lineno, sub.col_offset, "WR002",
+                    f"optional wire key {msg.name}.{key} read by "
+                    f"subscript without a default or presence guard "
+                    f"(an old peer omits it: use .get or 'if "
+                    f"{key!r} in ...')",
+                ))
+        for fld in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, fld, []) or []:
+                visit(s, stack)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for s in handler.body:
+                visit(s, stack)
+
+    for s in func.body:
+        visit(s, ())
+
+
+def _check_golden(reg: Registry, golden_path: str) -> List[Finding]:
+    """WR003: the live registry vs the frozen committed golden.
+    Anything but byte-equality of the evolution projection fires — the
+    legal additive-optional change regenerates the golden, which shows
+    up as a reviewable wire_schema.json diff, never as silence."""
+    out: List[Finding] = []
+    rp = reg.path
+
+    def f(line: int, msg: str) -> None:
+        out.append(Finding(rp, line, 0, "WR003", msg))
+
+    # registry-internal version discipline first (these hold even
+    # before a golden exists)
+    for m in reg.messages:
+        if m.since not in reg.versions:
+            f(m.line,
+              f"message {m.name!r} since=v{m.since} has no VERSIONS "
+              f"row (a new version needs a declared history entry)")
+        for k in m.keys:
+            if k.since not in reg.versions:
+                f(k.line,
+                  f"wire key {m.name}.{k.name} since=v{k.since} has no "
+                  f"VERSIONS row (a new key needs a version entry)")
+            if k.since > m.since and not k.optional:
+                f(k.line,
+                  f"wire key {m.name}.{k.name} was added at v{k.since} "
+                  f"(after the message's v{m.since}) but is required: "
+                  f"a v{m.since} peer could never have emitted it")
+    if reg.protocol_version not in reg.versions:
+        f(0, f"PROTOCOL_VERSION v{reg.protocol_version} has no VERSIONS "
+             f"row")
+
+    try:
+        with open(golden_path) as fh:
+            golden = json.load(fh)
+    except (OSError, ValueError) as e:
+        f(0, f"frozen golden {golden_path} unreadable "
+             f"({type(e).__name__}: {e}): commit it via "
+             f"`python -m cyclonus_tpu.worker.wireregistry "
+             f"--write-golden`")
+        return out
+
+    if golden.get("schema_version") != reg.protocol_version:
+        f(0, f"registry PROTOCOL_VERSION v{reg.protocol_version} != "
+             f"golden schema_version "
+             f"v{golden.get('schema_version')}: regenerate the golden "
+             f"to make the protocol change explicit")
+    gmessages = golden.get("messages") or {}
+    for name in sorted(set(gmessages) - {m.name for m in reg.messages}):
+        f(0, f"wire message {name!r} was removed from the registry but "
+             f"is frozen in the golden (removal breaks every old peer)")
+    for m in reg.messages:
+        gm = gmessages.get(m.name)
+        if gm is None:
+            f(m.line,
+              f"wire message {m.name!r} has no golden row: regenerate "
+              f"the golden to commit the protocol change")
+            continue
+        if gm.get("since") != m.since:
+            f(m.line,
+              f"message {m.name!r} since flipped v{gm.get('since')} -> "
+              f"v{m.since} against the frozen golden")
+        if gm.get("epoch", "") != m.epoch:
+            f(m.line,
+              f"message {m.name!r} epoch rule changed "
+              f"{gm.get('epoch', '')!r} -> {m.epoch!r} against the "
+              f"frozen golden")
+        gkeys = gm.get("keys") or {}
+        for kname in sorted(set(gkeys) - {k.name for k in m.keys}):
+            f(m.line,
+              f"wire key {m.name}.{kname} was removed from the "
+              f"registry but is frozen in the golden (removal breaks "
+              f"every old peer)")
+        for k in m.keys:
+            gk = gkeys.get(k.name)
+            if gk is None:
+                f(k.line,
+                  f"wire key {m.name}.{k.name} has no golden row: "
+                  f"regenerate the golden to commit the additive "
+                  f"change")
+                continue
+            if gk.get("type") != k.type:
+                f(k.line,
+                  f"wire key {m.name}.{k.name} re-typed "
+                  f"{gk.get('type')!r} -> {k.type!r} against the "
+                  f"frozen golden (re-typing breaks old readers)")
+            if bool(gk.get("optional")) != k.optional:
+                flip = (
+                    "optional -> required" if k.optional is False
+                    else "required -> optional"
+                )
+                f(k.line,
+                  f"wire key {m.name}.{k.name} optionality flipped "
+                  f"({flip}) against the frozen golden")
+            if gk.get("since") != k.since:
+                f(k.line,
+                  f"wire key {m.name}.{k.name} version pin drifted "
+                  f"v{gk.get('since')} -> v{k.since} against the "
+                  f"frozen golden")
+    return out
+
+
+def _check_registry_wr005(reg: Registry) -> List[Finding]:
+    out: List[Finding] = []
+    for m in reg.messages:
+        for k in m.keys:
+            if k.type == "float" and not k.canon:
+                out.append(Finding(
+                    reg.path, k.line, 0, "WR005",
+                    f"float wire key {m.name}.{k.name} declares no "
+                    f"canonicalization (canon=''): raw floats drift "
+                    f"across peers — declare e.g. canon='round-ms'",
+                ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# The lint proper.
+# --------------------------------------------------------------------------
+
+def lint_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, object]]:
+    files = iter_py_files(paths)
+    registry_path = find_registry(paths)
+    findings: List[Finding] = []
+    empty_stats = {
+        "files": len(files), "messages": 0, "keys": 0,
+        "emit_sites": 0, "read_sites": 0, "annotations": 0,
+        "findings": 1,
+    }
+    if registry_path is None:
+        findings.append(Finding(
+            paths[0] if paths else ".", 0, 0, "WR001",
+            "cyclonus_tpu/worker/wireregistry.py not found: the wire "
+            "protocol has no declared registry to lint against",
+        ))
+        return findings, empty_stats
+    reg = load_registry(registry_path)
+    if reg is None or not reg.messages:
+        findings.append(Finding(
+            registry_path, 0, 0, "WR001",
+            "wire registry unparseable or empty",
+        ))
+        return findings, empty_stats
+
+    msg_names = {m.name for m in reg.messages}
+    stamp_msgs = {m.name for m in reg.messages if m.epoch == "stamp"}
+    annotations = len(reg.messages) + sum(
+        len(m.keys) for m in reg.messages
+    )
+    emit_sites = 0
+    read_sites = 0
+
+    # registry-side findings (anchored at declaration lines, so the
+    # registry file's own ignore comments apply)
+    reg_findings = _check_golden(reg, golden_path_for(registry_path))
+    reg_findings.extend(_check_registry_wr005(reg))
+    try:
+        with open(reg.path) as f:
+            reg_lines = f.read().splitlines()
+    except OSError:
+        reg_lines = []
+    findings.extend(suppress(reg_findings, reg_lines, _IGNORE_RE))
+
+    for path in files:
+        if os.path.basename(path) == REGISTRY_BASENAME:
+            continue  # the declarations are not emit/read sites
+        try:
+            with open(path, "r") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            findings.append(Finding(path, 0, 0, "WR000", "syntax error"))
+            continue
+        lines = source.splitlines()
+        file_findings: List[Finding] = []
+
+        # model classes named after registered messages: to_dict /
+        # to_json are emit sites, from_dict / from_json are read sites
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            msg = reg.message(node.name)
+            if msg is None:
+                continue
+            for sub in node.body:
+                if not isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if sub.name in ("to_dict", "to_json"):
+                    if _check_class_emit(path, msg, sub, file_findings):
+                        emit_sites += 1
+                elif sub.name in ("from_dict", "from_json"):
+                    _check_parse_reads(path, msg, sub, file_findings)
+                    read_sites += 1
+
+        # marker-annotated sites (reply builders, peer-line parsers)
+        seen_emit_funcs = set()
+        for lineno, text in enumerate(lines, 1):
+            em = _EMIT_MARK_RE.search(text)
+            if em is not None:
+                annotations += 1
+                name = em.group(1)
+                msg = reg.message(name)
+                func = _enclosing_func(tree, lineno)
+                if msg is None:
+                    file_findings.append(Finding(
+                        path, lineno, 0, "WR001",
+                        f"wire-emit marker names unregistered message "
+                        f"{name!r}",
+                    ))
+                elif func is not None and (
+                    (func.name, name) not in seen_emit_funcs
+                ):
+                    seen_emit_funcs.add((func.name, name))
+                    var = _emit_var(func)
+                    if var is not None:
+                        emit_sites += 1
+                        _check_marker_emit(
+                            path, msg, func, var, file_findings
+                        )
+            rm = _READ_MARK_RE.search(text)
+            if rm is not None:
+                annotations += 1
+                name = rm.group(1)
+                msg = reg.message(name)
+                func = _enclosing_func(tree, lineno)
+                if msg is None:
+                    file_findings.append(Finding(
+                        path, lineno, 0, "WR001",
+                        f"wire-read marker names unregistered message "
+                        f"{name!r}",
+                    ))
+                elif func is not None:
+                    read_sites += 1
+                    _check_parse_reads(path, msg, func, file_findings)
+
+        # WR004 stamp discipline + live-annotation census over calls
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _call_name(node)
+            if leaf in stamp_msgs:
+                kwargs = {kw.arg for kw in node.keywords if kw.arg}
+                if "epoch" not in kwargs:
+                    file_findings.append(Finding(
+                        path, node.lineno, node.col_offset, "WR004",
+                        f"{leaf} is an epoch-stamped message "
+                        f"(epoch='stamp') but this constructor call "
+                        f"passes no epoch= (every instance must carry "
+                        f"the batch epoch it was computed at)",
+                    ))
+            elif leaf == "wire_table":
+                arg = _const_str(node.args[0]) if node.args else None
+                if arg in msg_names:
+                    annotations += 1
+            elif leaf in ("check_wire", "check_wire_read"):
+                annotations += 1
+
+        findings.extend(suppress(file_findings, lines, _IGNORE_RE))
+
+    stats = {
+        "files": len(files),
+        "messages": len(reg.messages),
+        "keys": sum(len(m.keys) for m in reg.messages),
+        "emit_sites": emit_sites,
+        "read_sites": read_sites,
+        "annotations": annotations,
+        "findings": len(findings),
+        "registry": reg,
+        "registry_path": registry_path,
+    }
+    return (
+        sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code)),
+        stats,
+    )
+
+
+# --------------------------------------------------------------------------
+# Manifest (pinned byte-identical to wireregistry.manifest()).
+# --------------------------------------------------------------------------
+
+def build_manifest(reg: Registry) -> Dict:
+    return {
+        "version": 1,
+        "protocol_version": reg.protocol_version,
+        "versions": {
+            str(v): note for v, note in sorted(reg.versions.items())
+        },
+        "messages": [
+            {
+                "name": m.name,
+                "since": m.since,
+                "epoch": m.epoch,
+                "note": m.note,
+                "keys": [
+                    {
+                        "name": k.name,
+                        "type": k.type,
+                        "optional": k.optional,
+                        "since": k.since,
+                        "guard": k.effective_guard(),
+                        "canon": k.canon,
+                        "portable": k.portable,
+                        "ref": k.ref,
+                        "sample": k.sample,
+                        "note": k.note,
+                    }
+                    for k in m.keys
+                ],
+            }
+            for m in reg.messages
+        ],
+    }
+
+
+def _post(args, findings, stats) -> None:
+    stats.pop("registry", None)
+    stats.pop("registry_path", None)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run_cli(
+        "wirelint",
+        __doc__,
+        lint_paths,
+        DEFAULT_PATHS,
+        lambda findings, stats: (
+            f"wirelint: {len(findings)} finding(s), "
+            f"{stats['messages']} message / {stats['keys']} key "
+            f"declaration(s), {stats['emit_sites']}+{stats['read_sites']} "
+            f"emit/read site(s), {stats['annotations']} live "
+            f"annotation(s) in {stats['files']} file(s)"
+        ),
+        argv,
+        post=_post,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
